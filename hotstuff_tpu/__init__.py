@@ -1,0 +1,16 @@
+"""tpu-hotstuff: a TPU-native 2-chain HotStuff BFT consensus framework.
+
+A brand-new framework with the capabilities of the reference Rust implementation
+(mvidigueira/hotstuff): a committee of nodes runs leader-based 2-chain HotStuff
+(propose -> vote -> QC, with timeout/TC view change) over TCP, a mempool plane
+batches and disseminates client transaction payloads so consensus orders only
+digests, and a persistent store holds blocks/payloads.
+
+The cryptographic hot path -- batched vote/signature verification and QC
+aggregation (reference: crypto/src/lib.rs:194-220, consensus/src/messages.rs:197)
+-- sits behind a pluggable CryptoBackend with a CPU ed25519 baseline and a
+JAX TPU backend that verifies large signature batches as a single vmapped
+kernel, sharded over a device mesh at scale (hotstuff_tpu.ops, hotstuff_tpu.parallel).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,30 @@
+from .primitives import (
+    Digest,
+    Hashable,
+    KeyPair,
+    PublicKey,
+    SecretKey,
+    Signature,
+    generate_keypair,
+    generate_production_keypair,
+    sha512_32,
+)
+from .backend import CryptoBackend, CpuBackend, get_backend, set_backend
+from .service import SignatureService
+
+__all__ = [
+    "Digest",
+    "Hashable",
+    "KeyPair",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "generate_keypair",
+    "generate_production_keypair",
+    "sha512_32",
+    "CryptoBackend",
+    "CpuBackend",
+    "get_backend",
+    "set_backend",
+    "SignatureService",
+]

@@ -1,0 +1,96 @@
+"""Pluggable crypto execution backends.
+
+This is the `CryptoBackend` seam called for by the north star: the reference
+hard-wires ed25519_dalek's `verify_batch` (crypto/src/lib.rs:194-220); here
+every batch verification dispatches through an interchangeable backend so the
+hot path can run either on host CPU (baseline) or as a vmapped JAX kernel on
+TPU (hotstuff_tpu.ops.ed25519), sharded over a device mesh at scale.
+"""
+
+from __future__ import annotations
+
+import abc
+import threading
+from typing import Sequence
+
+from cryptography.exceptions import InvalidSignature
+
+from .primitives import PublicKey, Signature
+
+
+class CryptoBackend(abc.ABC):
+    """Batch signature verification engine.
+
+    Contract (matching ed25519_dalek `verify_batch`): returns True iff ALL
+    (message, key, signature) triples verify. `verify_batch_mask` additionally
+    reports per-item validity (needed to avoid re-verifying a whole QC when
+    one Byzantine vote is bad)."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> list[bool]: ...
+
+    def verify_batch(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> bool:
+        if not messages:
+            return True
+        return all(self.verify_batch_mask(messages, keys, signatures))
+
+
+class CpuBackend(CryptoBackend):
+    """Host ed25519 via OpenSSL (`cryptography`) -- the parity baseline,
+    equivalent to the reference's ed25519_dalek CPU path."""
+
+    name = "cpu"
+
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> list[bool]:
+        out = []
+        for msg, pk, sig in zip(messages, keys, signatures, strict=True):
+            try:
+                pk.to_crypto().verify(sig.data, msg)
+                out.append(True)
+            except (InvalidSignature, ValueError):
+                out.append(False)
+        return out
+
+
+_lock = threading.Lock()
+_backend: CryptoBackend = CpuBackend()
+
+
+def get_backend() -> CryptoBackend:
+    return _backend
+
+
+def set_backend(backend: CryptoBackend) -> CryptoBackend:
+    """Install the active backend (e.g. TpuBackend); returns the previous one."""
+    global _backend
+    with _lock:
+        prev, _backend = _backend, backend
+    return prev
+
+
+def make_backend(kind: str, **kwargs) -> CryptoBackend:
+    """Factory used by the node CLI's --crypto flag (cpu | tpu)."""
+    if kind == "cpu":
+        return CpuBackend()
+    if kind == "tpu":
+        from .tpu_backend import TpuBackend
+
+        return TpuBackend(**kwargs)
+    raise ValueError(f"unknown crypto backend {kind!r}")

@@ -1,0 +1,227 @@
+"""Core crypto value types: digests, ed25519 keys and signatures.
+
+Capability parity with the reference `crypto` crate (crypto/src/lib.rs:20-224):
+  * Digest        -- 32-byte content hash with base64 display   (lib.rs:20-59)
+  * PublicKey     -- 32-byte ed25519 public key, base64 serde   (lib.rs:62-108)
+  * SecretKey     -- ed25519 secret key, zeroized on drop       (lib.rs:110-164)
+  * Signature     -- 64-byte ed25519 signature over a Digest    (lib.rs:166-224)
+  * generate_keypair(seeded rng) / generate_production_keypair  (lib.rs:156-164)
+
+Single verification uses the host CPU (OpenSSL via `cryptography`); the batch
+paths (`Signature.verify_batch` / `verify_batch_alt`, mirroring lib.rs:194-220)
+dispatch through the pluggable CryptoBackend so they can run vmapped on TPU.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+from dataclasses import dataclass
+from typing import Iterable, Protocol, Sequence
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+
+def sha512_32(data: bytes) -> bytes:
+    """SHA-512 truncated to 32 bytes -- the reference's digest function
+    (consensus/src/messages.rs digest() impls use Sha512 -> [u8;32])."""
+    return hashlib.sha512(data).digest()[:32]
+
+
+def _b64(data: bytes) -> str:
+    return base64.standard_b64encode(data).decode("ascii")
+
+
+@dataclass(frozen=True, slots=True)
+class Digest:
+    """32-byte content hash (reference crypto/src/lib.rs:20-59)."""
+
+    data: bytes
+
+    SIZE = 32
+
+    def __post_init__(self) -> None:
+        if len(self.data) != self.SIZE:
+            raise ValueError(f"Digest must be {self.SIZE} bytes, got {len(self.data)}")
+
+    @staticmethod
+    def of(data: bytes) -> "Digest":
+        return Digest(sha512_32(data))
+
+    @staticmethod
+    def zero() -> "Digest":
+        return Digest(bytes(Digest.SIZE))
+
+    def __str__(self) -> str:  # base64 like the reference Display impl
+        return _b64(self.data)
+
+    def short(self) -> str:
+        """First 8 chars of base64 -- used in log lines for readability."""
+        return _b64(self.data)[:8]
+
+    def __repr__(self) -> str:
+        return f"Digest({_b64(self.data)})"
+
+
+class Hashable(Protocol):
+    """The reference `Hash` trait (crypto/src/lib.rs:55-59)."""
+
+    def digest(self) -> Digest: ...
+
+
+@dataclass(frozen=True, slots=True)
+class PublicKey:
+    """ed25519 public key, 32 bytes (reference crypto/src/lib.rs:62-108)."""
+
+    data: bytes
+
+    SIZE = 32
+
+    def __post_init__(self) -> None:
+        if len(self.data) != self.SIZE:
+            raise ValueError(f"PublicKey must be {self.SIZE} bytes")
+
+    def encode_base64(self) -> str:
+        return _b64(self.data)
+
+    @staticmethod
+    def decode_base64(s: str) -> "PublicKey":
+        return PublicKey(base64.standard_b64decode(s))
+
+    def __str__(self) -> str:
+        return self.encode_base64()
+
+    def short(self) -> str:
+        return self.encode_base64()[:8]
+
+    def __lt__(self, other: "PublicKey") -> bool:
+        return self.data < other.data
+
+    def to_crypto(self) -> Ed25519PublicKey:
+        return Ed25519PublicKey.from_public_bytes(self.data)
+
+
+class SecretKey:
+    """ed25519 secret key (32-byte seed). Best-effort zeroized on drop,
+    mirroring the reference's Drop impl (crypto/src/lib.rs:146-153)."""
+
+    SIZE = 32
+
+    def __init__(self, seed: bytes) -> None:
+        if len(seed) != self.SIZE:
+            raise ValueError(f"SecretKey must be {self.SIZE} bytes")
+        self._seed = bytearray(seed)
+
+    @property
+    def data(self) -> bytes:
+        return bytes(self._seed)
+
+    def encode_base64(self) -> str:
+        return _b64(bytes(self._seed))
+
+    @staticmethod
+    def decode_base64(s: str) -> "SecretKey":
+        return SecretKey(base64.standard_b64decode(s))
+
+    def to_crypto(self) -> Ed25519PrivateKey:
+        return Ed25519PrivateKey.from_private_bytes(bytes(self._seed))
+
+    def __del__(self) -> None:
+        for i in range(len(self._seed)):
+            self._seed[i] = 0
+
+
+KeyPair = tuple[PublicKey, SecretKey]
+
+
+def generate_keypair(rng) -> KeyPair:
+    """Deterministic keypair from a seeded `random.Random` (or any object with
+    `.randbytes`). Mirrors generate_keypair(csprng) (crypto/src/lib.rs:156-158),
+    which tests seed with StdRng::from_seed([0;32])."""
+    seed = rng.randbytes(32)
+    return _keypair_from_seed(seed)
+
+
+def generate_production_keypair() -> KeyPair:
+    """OS-entropy keypair (crypto/src/lib.rs:161-164)."""
+    return _keypair_from_seed(os.urandom(32))
+
+
+def _keypair_from_seed(seed: bytes) -> KeyPair:
+    sk = SecretKey(seed)
+    pub = sk.to_crypto().public_key().public_bytes_raw()
+    return PublicKey(pub), sk
+
+
+@dataclass(frozen=True, slots=True)
+class Signature:
+    """ed25519 signature over a Digest's 32 bytes (crypto/src/lib.rs:166-224).
+
+    The reference splits the 64 bytes into two 32-byte halves (part1/part2) for
+    serde; we keep the flat 64 bytes and expose `flatten()` for parity.
+    """
+
+    data: bytes
+
+    SIZE = 64
+
+    def __post_init__(self) -> None:
+        if len(self.data) != self.SIZE:
+            raise ValueError(f"Signature must be {self.SIZE} bytes")
+
+    @staticmethod
+    def new(digest: Digest, secret: SecretKey) -> "Signature":
+        sig = secret.to_crypto().sign(digest.data)
+        return Signature(sig)
+
+    def flatten(self) -> bytes:
+        return self.data
+
+    def verify(self, digest: Digest, public_key: PublicKey) -> bool:
+        """Single strict verification (crypto/src/lib.rs:186-192)."""
+        try:
+            public_key.to_crypto().verify(self.data, digest.data)
+            return True
+        except InvalidSignature:
+            return False
+        except ValueError:
+            return False  # malformed public key bytes
+
+    @staticmethod
+    def verify_batch(
+        digest: Digest, votes: Iterable[tuple[PublicKey, "Signature"]]
+    ) -> bool:
+        """Many signatures over ONE message -- the QC::verify path
+        (crypto/src/lib.rs:194-207, consensus/src/messages.rs:197).
+        Dispatches through the active CryptoBackend."""
+        from .backend import get_backend
+
+        votes = list(votes)
+        return get_backend().verify_batch(
+            [digest.data] * len(votes),
+            [pk for pk, _ in votes],
+            [sig for _, sig in votes],
+        )
+
+    @staticmethod
+    def verify_batch_alt(
+        messages: Sequence[bytes],
+        keys_sigs: Sequence[tuple[PublicKey, "Signature"]],
+    ) -> bool:
+        """Many signatures over DISTINCT messages -- the fork's mempool
+        workload (crypto/src/lib.rs:209-220, mempool/src/core.rs:135-148).
+        Dispatches through the active CryptoBackend."""
+        from .backend import get_backend
+
+        if len(messages) != len(keys_sigs):
+            raise ValueError("messages and signatures length mismatch")
+        return get_backend().verify_batch(
+            list(messages),
+            [pk for pk, _ in keys_sigs],
+            [sig for _, sig in keys_sigs],
+        )

@@ -1,0 +1,34 @@
+"""SignatureService: the signing actor.
+
+Mirrors the reference's SignatureService (crypto/src/lib.rs:226-252): an actor
+owns the secret key and serves signing requests over a channel with oneshot
+replies. The request/reply seam is deliberately async so a remote accelerator
+(or a native signer thread) can sit behind the same interface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from .primitives import Digest, SecretKey, Signature
+from ..utils.actors import spawn
+
+
+class SignatureService:
+    """Clone-able signing handle backed by a single signer task."""
+
+    def __init__(self, secret: SecretKey) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue(100)
+        self._task = spawn(self._run(secret), name="signature-service")
+
+    async def _run(self, secret: SecretKey) -> None:
+        key = secret.to_crypto()
+        while True:
+            digest, fut = await self._queue.get()
+            if not fut.cancelled():
+                fut.set_result(Signature(key.sign(digest.data)))
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((digest, fut))
+        return await fut

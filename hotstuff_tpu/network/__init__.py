@@ -1,0 +1,3 @@
+from .net import NetMessage, NetReceiver, NetSender
+
+__all__ = ["NetMessage", "NetReceiver", "NetSender"]

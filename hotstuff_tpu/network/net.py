@@ -1,0 +1,181 @@
+"""Point-to-point network plane: fire-and-forget sender + framed receiver.
+
+Capability parity with the reference `network` crate (network/src/lib.rs):
+  * NetMessage(bytes, [addr..]) -- one payload, a list of recipients
+    (network/src/lib.rs:27)
+  * NetSender -- one worker task + bounded queue per peer, lazy connect,
+    drop-on-failure (reliability is the protocol's job via sync retries)
+    (network/src/lib.rs:29-87)
+  * NetReceiver -- TCP accept loop, one worker per inbound connection, reads
+    length-delimited frames, decodes, forwards to a delivery channel
+    (network/src/lib.rs:89-144)
+
+Wire format: 4-byte big-endian length prefix (tokio LengthDelimitedCodec
+default) followed by the codec payload. Properties the protocol relies on:
+per-peer FIFO (one ordered TCP stream + per-peer queue), at-most-once, NO
+delivery guarantee. This is the control plane and deliberately stays on
+host CPU/TCP; ICI collectives appear only inside the TPU crypto step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from dataclasses import dataclass
+from typing import Callable
+
+from ..utils.actors import channel, spawn
+
+log = logging.getLogger("hotstuff.network")
+
+Address = tuple[str, int]
+
+MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
+
+
+@dataclass(slots=True)
+class NetMessage:
+    """(serialized bytes, recipient addresses) -- network/src/lib.rs:27."""
+
+    data: bytes
+    addresses: list[Address]
+
+
+def frame(data: bytes) -> bytes:
+    return struct.pack(">I", len(data)) + data
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
+    """Read one length-delimited frame; None on clean EOF."""
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame too large: {length}")
+    try:
+        return await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+
+
+class NetSender:
+    """Receives NetMessage from a channel; maintains one worker (with its own
+    bounded queue) per peer address so a slow peer never blocks broadcast
+    (network/src/lib.rs:44-57,60-86)."""
+
+    PEER_QUEUE = 1_000
+
+    def __init__(self, rx: asyncio.Queue, name: str = "net-sender") -> None:
+        self._rx = rx
+        self._name = name
+        self._peers: dict[Address, asyncio.Queue] = {}
+        self._task = spawn(self._run(), name=name)
+
+    async def _run(self) -> None:
+        while True:
+            msg: NetMessage = await self._rx.get()
+            payload = frame(msg.data)
+            for addr in msg.addresses:
+                q = self._peers.get(addr)
+                if q is None:
+                    q = asyncio.Queue(self.PEER_QUEUE)
+                    self._peers[addr] = q
+                    spawn(self._worker(addr, q), name=f"{self._name}-{addr}")
+                try:
+                    q.put_nowait(payload)
+                except asyncio.QueueFull:
+                    # Fire-and-forget: drop rather than block the fan-out.
+                    log.debug("dropping message to %s: peer queue full", addr)
+
+    async def _worker(self, addr: Address, q: asyncio.Queue) -> None:
+        """Per-peer worker: lazily connects, writes frames in FIFO order,
+        drops messages while the peer is unreachable."""
+        writer: asyncio.StreamWriter | None = None
+        while True:
+            payload = await q.get()
+            if writer is None:
+                try:
+                    _, writer = await asyncio.open_connection(addr[0], addr[1])
+                except OSError as e:
+                    log.debug("failed to connect to %s: %s", addr, e)
+                    continue  # drop this message
+            try:
+                writer.write(payload)
+                await writer.drain()
+            except (ConnectionError, OSError) as e:
+                log.debug("failed to send to %s: %s", addr, e)
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                writer = None  # reconnect lazily on next message
+
+
+class NetReceiver:
+    """Binds a listener; every inbound connection gets a worker that decodes
+    frames and forwards them into the delivery channel
+    (network/src/lib.rs:89-144)."""
+
+    def __init__(
+        self,
+        address: Address,
+        deliver: asyncio.Queue,
+        decode: Callable[[bytes], object],
+        name: str = "net-receiver",
+    ) -> None:
+        self._address = address
+        self._deliver = deliver
+        self._decode = decode
+        self._name = name
+        self._server: asyncio.AbstractServer | None = None
+        self._task = spawn(self._run(), name=name)
+
+    async def _run(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, host=self._address[0], port=self._address[1]
+        )
+        log.debug("%s listening on %s", self._name, self._address)
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        while True:
+            try:
+                data = await read_frame(reader)
+            except ConnectionError as e:
+                log.warning("%s: dropping connection from %s: %s", self._name, peer, e)
+                break
+            if data is None:
+                break
+            try:
+                message = self._decode(data)
+            except Exception as e:
+                log.warning("%s: undecodable frame from %s: %r", self._name, peer, e)
+                continue
+            await self._deliver.put(message)
+        try:
+            writer.close()
+        except Exception:
+            pass
+
+
+class SimpleSender:
+    """Convenience owner of a NetSender: exposes send/broadcast coroutines.
+    Plays the role of Synchronizer::transmit's shared send path
+    (consensus/src/synchronizer.rs:109-129)."""
+
+    def __init__(self, name: str = "sender") -> None:
+        self._tx = channel()
+        self._sender = NetSender(self._tx, name=name)
+
+    async def send(self, data: bytes, address: Address) -> None:
+        await self._tx.put(NetMessage(data, [address]))
+
+    async def broadcast(self, data: bytes, addresses: list[Address]) -> None:
+        await self._tx.put(NetMessage(data, list(addresses)))

@@ -1,0 +1,105 @@
+"""Persistent keyed byte store with a single-writer actor and notify_read.
+
+Capability parity with the reference `store` crate (store/src/lib.rs:15-92):
+  * one writer task owns all state; commands arrive over a channel
+  * Write / Read / NotifyRead commands with oneshot replies
+  * NotifyRead registers an obligation resolved by a FUTURE Write of that key
+    -- the synchronizers' wait primitive for out-of-order block/payload arrival
+
+The reference persists via rocksdb; here durability comes from an append-only
+length-prefixed log replayed on open (a native C++ log-structured store under
+native/ can be slotted in behind the same command protocol).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from collections import defaultdict, deque
+
+from ..utils.actors import channel, spawn
+
+
+class Store:
+    """Async KV store handle; cheap to share (all ops go through one queue)."""
+
+    def __init__(self, path: str | None = None) -> None:
+        self._data: dict[bytes, bytes] = {}
+        self._obligations: dict[bytes, deque[asyncio.Future]] = defaultdict(deque)
+        self._queue = channel()
+        self._path = path
+        self._log = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._replay(path)
+            self._log = open(path, "ab")
+        self._task = spawn(self._run(), name="store-writer")
+
+    def _replay(self, path: str) -> None:
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as f:
+            buf = f.read()
+        pos = 0
+        while pos + 8 <= len(buf):
+            klen, vlen = struct.unpack_from("<II", buf, pos)
+            end = pos + 8 + klen + vlen
+            if end > len(buf):
+                break  # torn tail write; ignore
+            key = buf[pos + 8 : pos + 8 + klen]
+            val = buf[pos + 8 + klen : end]
+            self._data[key] = val
+            pos = end
+
+    async def _run(self) -> None:
+        while True:
+            cmd, args, fut = await self._queue.get()
+            if cmd == "write":
+                key, value = args
+                self._data[key] = value
+                if self._log is not None:
+                    self._log.write(struct.pack("<II", len(key), len(value)))
+                    self._log.write(key)
+                    self._log.write(value)
+                    self._log.flush()
+                # Resolve pending notify_read obligations for this key
+                # (store/src/lib.rs:36-47).
+                for waiter in self._obligations.pop(key, ()):
+                    if not waiter.cancelled():
+                        waiter.set_result(value)
+                if fut is not None and not fut.cancelled():
+                    fut.set_result(None)
+            elif cmd == "read":
+                (key,) = args
+                if not fut.cancelled():
+                    fut.set_result(self._data.get(key))
+            elif cmd == "notify_read":
+                (key,) = args
+                if key in self._data:
+                    if not fut.cancelled():
+                        fut.set_result(self._data[key])
+                else:
+                    self._obligations[key].append(fut)
+
+    async def write(self, key: bytes, value: bytes) -> None:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(("write", (key, value), fut))
+        await fut
+
+    async def read(self, key: bytes) -> bytes | None:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(("read", (key,), fut))
+        return await fut
+
+    async def notify_read(self, key: bytes) -> bytes:
+        """Blocking read: resolves immediately if present, else when a later
+        write stores the key (store/src/lib.rs:49-57)."""
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put(("notify_read", (key,), fut))
+        return await fut
+
+    def close(self) -> None:
+        self._task.cancel()
+        if self._log is not None:
+            self._log.close()

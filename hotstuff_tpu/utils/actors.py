@@ -1,0 +1,122 @@
+"""Actor/channel utilities: the framework's async substrate.
+
+The reference is an actor-per-subsystem design on tokio: every component owns
+an mpsc receiver and runs an infinite select! loop in its own task, with no
+shared mutable state (18 tokio::spawn sites; SURVEY.md section 1). This module
+provides the same discipline on asyncio: bounded channels, tracked spawns, and
+a select-like multiplexer for (channel, timer) loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Coroutine, TypeVar
+
+log = logging.getLogger("hotstuff.actors")
+
+T = TypeVar("T")
+
+# Default channel capacity, matching the reference's mpsc bounds (100-1000).
+CHANNEL_CAPACITY = 1_000
+
+
+def channel(capacity: int = CHANNEL_CAPACITY) -> asyncio.Queue:
+    return asyncio.Queue(capacity)
+
+
+_tasks: set[asyncio.Task] = set()
+
+
+def spawn(coro: Coroutine, name: str | None = None) -> asyncio.Task:
+    """Spawn a long-lived actor task. Keeps a strong reference (asyncio only
+    holds weak refs) and logs unexpected termination -- actors are expected to
+    run forever, like the reference's spawned loops."""
+    task = asyncio.get_running_loop().create_task(coro, name=name)
+    _tasks.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        _tasks.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            log.error("actor %s crashed: %r", t.get_name(), exc, exc_info=exc)
+
+    task.add_done_callback(_done)
+    return task
+
+
+class Selector:
+    """Multiplexes many awaitable sources into one loop, like tokio::select!.
+
+    Each source is re-armed after it yields, so no message is lost. Branches
+    are (name, factory) where factory() returns a fresh awaitable.
+    """
+
+    def __init__(self) -> None:
+        self._factories: dict[str, Any] = {}
+        self._pending: dict[str, asyncio.Task] = {}
+
+    def add(self, name: str, factory) -> None:
+        self._factories[name] = factory
+
+    def remove(self, name: str) -> None:
+        self._factories.pop(name, None)
+        task = self._pending.pop(name, None)
+        if task is not None:
+            task.cancel()
+
+    async def next(self) -> tuple[str, Any]:
+        """Wait for the first ready branch; returns (name, value)."""
+        for name, factory in self._factories.items():
+            if name not in self._pending:
+                self._pending[name] = asyncio.ensure_future(factory())
+        while True:
+            done, _ = await asyncio.wait(
+                self._pending.values(), return_when=asyncio.FIRST_COMPLETED
+            )
+            # Deterministic order: iterate registration order, not set order.
+            for name in list(self._factories):
+                task = self._pending.get(name)
+                if task is not None and task.done() and task in done:
+                    del self._pending[name]
+                    value = task.result()
+                    return name, value
+
+    def close(self) -> None:
+        for task in self._pending.values():
+            task.cancel()
+        self._pending.clear()
+
+
+class Timer:
+    """Resettable timer (reference consensus/src/timer.rs:10-34): a future that
+    resolves `delay_ms` after the last reset(). Used by the pacemaker."""
+
+    def __init__(self, delay_ms: int) -> None:
+        self._delay = delay_ms / 1000.0
+        self._generation = 0
+        self._fired = asyncio.Event()
+        self._handle: asyncio.TimerHandle | None = None
+        self.reset()
+
+    def reset(self) -> None:
+        self._generation += 1
+        gen = self._generation
+        self._fired = asyncio.Event()
+        if self._handle is not None:
+            self._handle.cancel()
+        loop = asyncio.get_event_loop()
+        self._handle = loop.call_later(self._delay, self._fire, gen)
+
+    def _fire(self, gen: int) -> None:
+        if gen == self._generation:
+            self._fired.set()
+
+    async def wait(self) -> None:
+        await self._fired.wait()
+
+    def cancel(self) -> None:
+        if self._handle is not None:
+            self._handle.cancel()

@@ -1,0 +1,19 @@
+"""Shared deterministic fixtures, mirroring the reference's tests/common.rs:
+4 keypairs from a fixed seed (consensus/src/tests/common.rs:13-16) and sync
+builders for blocks/votes/QCs that bypass the async SignatureService
+(consensus/src/tests/common.rs:44-113)."""
+
+from __future__ import annotations
+
+import random
+
+from hotstuff_tpu.crypto import Digest, PublicKey, SecretKey, Signature
+
+SEED = 0
+
+
+def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
+    rng = random.Random(SEED)
+    from hotstuff_tpu.crypto import generate_keypair
+
+    return [generate_keypair(rng) for _ in range(n)]

@@ -1,0 +1,40 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh BEFORE jax imports.
+
+Multi-chip shardings are validated on this virtual mesh (no multi-chip TPU
+hardware is available in CI); the driver separately dry-runs
+__graft_entry__.dryrun_multichip the same way.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio
+
+import pytest
+
+
+@pytest.fixture
+def run_async():
+    """Run an async test body in a fresh event loop."""
+
+    def _run(coro, timeout=60.0):
+        return asyncio.run(asyncio.wait_for(coro, timeout))
+
+    return _run
+
+
+_PORT_COUNTER = [0]
+
+
+@pytest.fixture
+def base_port():
+    """Per-test port offset to avoid collisions, mirroring the reference's
+    increment_base_port (consensus/src/tests/common.rs:34-41)."""
+    _PORT_COUNTER[0] += 40
+    return 11_000 + (os.getpid() % 500) * 50 + _PORT_COUNTER[0]

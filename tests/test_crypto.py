@@ -1,0 +1,96 @@
+"""Crypto unit tests, mirroring crypto/src/tests/crypto_tests.rs:
+digest determinism, base64 round-trips, valid/invalid single verification,
+valid/invalid batch verification, signature service."""
+
+import random
+
+from hotstuff_tpu.crypto import (
+    Digest,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureService,
+    generate_keypair,
+    sha512_32,
+)
+from tests.common import keys
+
+
+def test_digest_deterministic():
+    d1 = Digest.of(b"hello")
+    d2 = Digest.of(b"hello")
+    assert d1 == d2
+    assert d1 != Digest.of(b"world")
+    assert len(d1.data) == 32
+    assert d1.data == sha512_32(b"hello")
+
+
+def test_keys_deterministic_from_seed():
+    assert [pk.data for pk, _ in keys()] == [pk.data for pk, _ in keys()]
+    pks = [pk for pk, _ in keys()]
+    assert len({pk.data for pk in pks}) == 4
+
+
+def test_base64_roundtrip():
+    pk, sk = keys()[0]
+    assert PublicKey.decode_base64(pk.encode_base64()) == pk
+    assert SecretKey.decode_base64(sk.encode_base64()).data == sk.data
+
+
+def test_sign_and_verify_valid():
+    pk, sk = keys()[0]
+    digest = Digest.of(b"message")
+    sig = Signature.new(digest, sk)
+    assert sig.verify(digest, pk)
+
+
+def test_verify_invalid_signature():
+    pk, sk = keys()[0]
+    digest = Digest.of(b"message")
+    sig = Signature.new(digest, sk)
+    assert not sig.verify(Digest.of(b"other"), pk)
+    bad = Signature(bytes(64))
+    assert not bad.verify(digest, pk)
+
+
+def test_verify_wrong_key():
+    (pk0, sk0), (pk1, _) = keys()[:2]
+    digest = Digest.of(b"message")
+    sig = Signature.new(digest, sk0)
+    assert not sig.verify(digest, pk1)
+
+
+def test_verify_batch_valid():
+    digest = Digest.of(b"batch message")
+    votes = [(pk, Signature.new(digest, sk)) for pk, sk in keys()]
+    assert Signature.verify_batch(digest, votes)
+
+
+def test_verify_batch_one_invalid():
+    digest = Digest.of(b"batch message")
+    votes = [(pk, Signature.new(digest, sk)) for pk, sk in keys()]
+    bad_pk, bad_sk = keys()[1]
+    votes[2] = (votes[2][0], Signature.new(Digest.of(b"evil"), bad_sk))
+    assert not Signature.verify_batch(digest, votes)
+
+
+def test_verify_batch_alt_distinct_messages():
+    msgs = [f"msg-{i}".encode() for i in range(4)]
+    pairs = []
+    for m, (pk, sk) in zip(msgs, keys()):
+        pairs.append((pk, Signature.new(Digest.of(m), sk)))
+    digests = [Digest.of(m).data for m in msgs]
+    assert Signature.verify_batch_alt(digests, pairs)
+    digests[0] = Digest.of(b"tampered").data
+    assert not Signature.verify_batch_alt(digests, pairs)
+
+
+def test_signature_service(run_async):
+    async def body():
+        pk, sk = keys()[0]
+        service = SignatureService(sk)
+        digest = Digest.of(b"service message")
+        sig = await service.request_signature(digest)
+        assert sig.verify(digest, pk)
+
+    run_async(body())

@@ -1,0 +1,39 @@
+"""Codec round-trip and malformed-input tests."""
+
+import pytest
+
+from hotstuff_tpu.utils.serde import Reader, SerdeError, Writer
+
+
+def test_primitive_roundtrip():
+    w = Writer()
+    w.u8(7)
+    w.u32(123_456)
+    w.u64(2**40)
+    w.var_bytes(b"payload")
+    w.fixed(b"x" * 32, 32)
+    w.seq([1, 2, 3], lambda wr, v: wr.u32(v))
+    r = Reader(w.bytes())
+    assert r.u8() == 7
+    assert r.u32() == 123_456
+    assert r.u64() == 2**40
+    assert r.var_bytes() == b"payload"
+    assert r.fixed(32) == b"x" * 32
+    assert r.seq(lambda rd: rd.u32()) == [1, 2, 3]
+    r.expect_done()
+
+
+def test_underrun_raises():
+    r = Reader(b"\x01\x02")
+    with pytest.raises(SerdeError):
+        r.u32()
+
+
+def test_trailing_garbage_raises():
+    w = Writer()
+    w.u8(1)
+    w.u8(2)
+    r = Reader(w.bytes())
+    r.u8()
+    with pytest.raises(SerdeError):
+        r.expect_done()

@@ -1,0 +1,54 @@
+"""Store tests, mirroring store/src/tests/store_tests.rs: create/read/write,
+read-missing, notify_read resolving on a later write, and persistence replay."""
+
+import os
+
+from hotstuff_tpu.store import Store
+
+
+def test_create_store_read_write(run_async, tmp_path):
+    async def body():
+        store = Store(str(tmp_path / "db" / "log"))
+        await store.write(b"key", b"value")
+        assert await store.read(b"key") == b"value"
+        assert await store.read(b"missing") is None
+        store.close()
+
+    run_async(body())
+
+
+def test_notify_read_resolves_on_later_write(run_async):
+    async def body():
+        import asyncio
+
+        store = Store()
+        waiter = asyncio.ensure_future(store.notify_read(b"future-key"))
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        await store.write(b"future-key", b"arrived")
+        assert await asyncio.wait_for(waiter, 1.0) == b"arrived"
+        # notify_read on a present key resolves immediately
+        assert await store.notify_read(b"future-key") == b"arrived"
+        store.close()
+
+    run_async(body())
+
+
+def test_persistence_replay(run_async, tmp_path):
+    path = str(tmp_path / "log")
+
+    async def write_phase():
+        store = Store(path)
+        await store.write(b"a", b"1")
+        await store.write(b"b", b"2")
+        await store.write(b"a", b"3")  # overwrite
+        store.close()
+
+    async def read_phase():
+        store = Store(path)
+        assert await store.read(b"a") == b"3"
+        assert await store.read(b"b") == b"2"
+        store.close()
+
+    run_async(write_phase())
+    run_async(read_phase())
