@@ -1,0 +1,64 @@
+"""Result aggregation (reference benchmark/benchmark/aggregate.py:75-174).
+
+Groups result .txt files by setup (nodes, faults, tx size), averages repeated
+runs, and emits agg-*.txt files consumable by plot.py.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import defaultdict
+from glob import glob
+from os.path import join
+from statistics import mean, stdev
+
+
+def _extract(text: str, pattern: str) -> float | None:
+    m = re.search(pattern, text)
+    return float(m.group(1).replace(",", "")) if m else None
+
+
+def parse_result_file(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    return {
+        "nodes": _extract(text, r"Committee size: ([\d,]+)"),
+        "faults": _extract(text, r"Faults: ([\d,]+)"),
+        "rate": _extract(text, r"Input rate: ([\d,]+)"),
+        "tx_size": _extract(text, r"Transaction size: ([\d,]+)"),
+        "consensus_tps": _extract(text, r"Consensus TPS: ([\d,]+)"),
+        "consensus_latency": _extract(text, r"Consensus latency: ([\d,]+)"),
+        "e2e_tps": _extract(text, r"End-to-end TPS: ([\d,]+)"),
+        "e2e_latency": _extract(text, r"End-to-end latency: ([\d,]+)"),
+    }
+
+
+def aggregate_results(directory: str = "results") -> dict:
+    """Means/stdevs per (nodes, faults, tx_size, rate) setup."""
+    groups: dict[tuple, list[dict]] = defaultdict(list)
+    for path in sorted(glob(join(directory, "bench-*.txt"))):
+        r = parse_result_file(path)
+        key = (r["nodes"], r["faults"], r["tx_size"], r["rate"])
+        groups[key].append(r)
+
+    out = {}
+    for key, runs in sorted(groups.items()):
+        agg = {}
+        for metric in ("consensus_tps", "consensus_latency", "e2e_tps", "e2e_latency"):
+            vals = [r[metric] for r in runs if r[metric] is not None]
+            agg[metric] = {
+                "mean": mean(vals) if vals else 0.0,
+                "stdev": stdev(vals) if len(vals) > 1 else 0.0,
+                "runs": len(vals),
+            }
+        out[key] = agg
+
+    lines = ["setup(nodes,faults,tx_size,rate) -> metric: mean ± stdev (runs)"]
+    for key, agg in out.items():
+        for metric, v in agg.items():
+            lines.append(
+                f"{key} {metric}: {v['mean']:.0f} ± {v['stdev']:.0f} ({v['runs']})"
+            )
+    with open(join(directory, "aggregated.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return out

@@ -1,0 +1,149 @@
+"""AWS EC2 testbed lifecycle (reference benchmark/aws/instance.py:18-268).
+
+Multi-region instance create/start/stop/terminate/info with a security group
+opening the consensus/mempool/front ports. Requires boto3 (not installed in
+this environment; the module imports it lazily).
+"""
+
+from __future__ import annotations
+
+from .settings import Settings
+
+
+class AWSError(Exception):
+    pass
+
+
+class InstanceManager:
+    SECURITY_GROUP_NAME = "hotstuff-tpu"
+    INSTANCE_NAME = "hotstuff-tpu-node"
+
+    def __init__(self, settings: Settings) -> None:
+        try:
+            import boto3
+        except ImportError as e:  # pragma: no cover
+            raise AWSError("boto3 is required for AWS testbeds") from e
+        self.settings = settings
+        self.clients = {
+            region: boto3.client("ec2", region_name=region)
+            for region in settings.aws_regions
+        }
+
+    @classmethod
+    def make(cls, settings_file: str = "settings.json") -> "InstanceManager":
+        return cls(Settings.load(settings_file))
+
+    def _security_group(self, client) -> None:
+        sg_rules = [
+            {
+                "IpProtocol": "tcp",
+                "FromPort": port,
+                "ToPort": port,
+                "IpRanges": [{"CidrIp": "0.0.0.0/0"}],
+            }
+            for port in (
+                22,
+                self.settings.base_port,
+                self.settings.mempool_port,
+                self.settings.front_port,
+            )
+        ]
+        try:
+            client.create_security_group(
+                GroupName=self.SECURITY_GROUP_NAME,
+                Description="hotstuff-tpu benchmark testbed",
+            )
+            client.authorize_security_group_ingress(
+                GroupName=self.SECURITY_GROUP_NAME, IpPermissions=sg_rules
+            )
+        except client.exceptions.ClientError as e:
+            if "InvalidGroup.Duplicate" not in str(e):
+                raise
+
+    def _get_ami(self, client) -> str:
+        # Latest Ubuntu 22.04 LTS amd64 image in the region.
+        images = client.describe_images(
+            Filters=[
+                {
+                    "Name": "name",
+                    "Values": ["ubuntu/images/hvm-ssd/ubuntu-jammy-22.04-amd64-server-*"],
+                },
+                {"Name": "state", "Values": ["available"]},
+            ],
+            Owners=["099720109477"],
+        )["Images"]
+        if not images:
+            raise AWSError("no Ubuntu AMI found")
+        return max(images, key=lambda im: im["CreationDate"])["ImageId"]
+
+    def create_instances(self, per_region: int) -> None:
+        for region, client in self.clients.items():
+            self._security_group(client)
+            client.run_instances(
+                ImageId=self._get_ami(client),
+                InstanceType=self.settings.instance_type,
+                KeyName=self.settings.key_name,
+                MinCount=per_region,
+                MaxCount=per_region,
+                SecurityGroups=[self.SECURITY_GROUP_NAME],
+                TagSpecifications=[
+                    {
+                        "ResourceType": "instance",
+                        "Tags": [{"Key": "Name", "Value": self.INSTANCE_NAME}],
+                    }
+                ],
+                BlockDeviceMappings=[
+                    {
+                        "DeviceName": "/dev/sda1",
+                        "Ebs": {"VolumeSize": 200, "VolumeType": "gp3"},
+                    }
+                ],
+            )
+            print(f"created {per_region} instances in {region}")
+
+    def _instances(self, client, states: list[str]):
+        out = client.describe_instances(
+            Filters=[
+                {"Name": "tag:Name", "Values": [self.INSTANCE_NAME]},
+                {"Name": "instance-state-name", "Values": states},
+            ]
+        )
+        for res in out["Reservations"]:
+            yield from res["Instances"]
+
+    def _apply(self, action: str, states: list[str]) -> None:
+        for region, client in self.clients.items():
+            ids = [i["InstanceId"] for i in self._instances(client, states)]
+            if not ids:
+                continue
+            getattr(client, action)(InstanceIds=ids)
+            print(f"{action} {len(ids)} instances in {region}")
+
+    def start_instances(self) -> None:
+        self._apply("start_instances", ["stopped"])
+
+    def stop_instances(self) -> None:
+        self._apply("stop_instances", ["running", "pending"])
+
+    def terminate_instances(self) -> None:
+        self._apply(
+            "terminate_instances", ["running", "pending", "stopping", "stopped"]
+        )
+
+    def hosts(self, flat: bool = False):
+        out = {}
+        for region, client in self.clients.items():
+            out[region] = [
+                i.get("PublicIpAddress")
+                for i in self._instances(client, ["running"])
+                if i.get("PublicIpAddress")
+            ]
+        if flat:
+            return [ip for ips in out.values() for ip in ips]
+        return out
+
+    def print_info(self) -> None:
+        for region, ips in self.hosts().items():
+            print(f"{region}: {len(ips)} running")
+            for ip in ips:
+                print(f"  ssh -i {self.settings.key_path} ubuntu@{ip}")

@@ -1,0 +1,175 @@
+"""Remote (multi-host) benchmark orchestration (reference benchmark/aws/remote.py:53-301).
+
+install -> update -> config -> run sweep (nodes x rate x runs) -> download +
+parse logs. Requires fabric (ssh) + boto3; imports are lazy so the rest of the
+harness works without them.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from os.path import basename, join, splitext
+
+from ..commands import CommandMaker
+from ..config import BenchParameters, NodeParameters
+from ..logs import LogParser
+from .instance import InstanceManager
+from .settings import Settings
+
+
+class BenchError(Exception):
+    pass
+
+
+class Bench:
+    def __init__(self, settings_file: str = "settings.json") -> None:
+        try:
+            from fabric import Connection  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise BenchError("fabric is required for remote benchmarks") from e
+        self.settings = Settings.load(settings_file)
+        self.manager = InstanceManager(self.settings)
+
+    def _connect(self, host: str):
+        from fabric import Connection
+
+        return Connection(
+            host, user="ubuntu", connect_kwargs={"key_filename": self.settings.key_path}
+        )
+
+    def _run_on(self, hosts: list[str], command: str) -> None:
+        for host in hosts:
+            self._connect(host).run(command, hide=True)
+
+    def install(self) -> None:
+        """Install the framework on all hosts (remote.py:79-110)."""
+        cmd = " && ".join(
+            [
+                "sudo apt-get update",
+                "sudo apt-get -y install python3-pip git",
+                f"(git clone {self.settings.repo_url} || true)",
+                f"cd {self.settings.repo_name} && git checkout {self.settings.branch}",
+                "pip3 install -e . || true",
+            ]
+        )
+        hosts = self.manager.hosts(flat=True)
+        self._run_on(hosts, cmd)
+        print(f"installed on {len(hosts)} hosts")
+
+    def _update(self, hosts: list[str]) -> None:
+        cmd = (
+            f"cd {self.settings.repo_name} && git fetch -f && "
+            f"git checkout -f {self.settings.branch} && git pull -f"
+        )
+        self._run_on(hosts, cmd)
+
+    def _config(self, hosts: list[str], node_params: NodeParameters) -> list[str]:
+        """Generate keys/committee locally and upload (remote.py:154-199)."""
+        import json
+        import subprocess
+
+        names = []
+        key_files = []
+        for i, _host in enumerate(hosts):
+            f = f".node-{i}.json"
+            subprocess.run(
+                CommandMaker.generate_key(f), shell=True, check=True,
+                capture_output=True,
+            )
+            from ..config import Key
+
+            names.append(Key.from_file(f).name)
+            key_files.append(f)
+
+        committee = {
+            "consensus": {
+                "epoch": 1,
+                "authorities": {
+                    n: {"stake": 1, "address": f"{h}:{self.settings.base_port}"}
+                    for n, h in zip(names, hosts)
+                },
+            },
+            "mempool": {
+                "epoch": 1,
+                "authorities": {
+                    n: {
+                        "front_address": f"{h}:{self.settings.front_port}",
+                        "mempool_address": f"{h}:{self.settings.mempool_port}",
+                    }
+                    for n, h in zip(names, hosts)
+                },
+            },
+        }
+        with open(".committee.json", "w") as f:
+            json.dump(committee, f, indent=2)
+        node_params.write(".parameters.json")
+
+        for i, host in enumerate(hosts):
+            c = self._connect(host)
+            c.run(f"rm -f {self.settings.repo_name}/.*.json", warn=True, hide=True)
+            for f in (key_files[i], ".committee.json", ".parameters.json"):
+                c.put(f, join(self.settings.repo_name, basename(f)))
+        return key_files
+
+    def _run_single(
+        self, hosts: list[str], rate: int, bench: BenchParameters, debug: bool
+    ) -> None:
+        """Launch nodes + clients over ssh (remote.py:200-247)."""
+        boot = hosts[: len(hosts) - bench.faults]
+        per_client_rate = max(1, rate // len(boot))
+        consensus_addrs = [f"{h}:{self.settings.base_port}" for h in boot]
+        for i, host in enumerate(boot):
+            node_cmd = CommandMaker.run_node(
+                f".node-{i}.json", ".committee.json", ".db/log", ".parameters.json",
+                debug=debug,
+            )
+            client_cmd = CommandMaker.run_client(
+                f"{host}:{self.settings.front_port}",
+                bench.tx_size,
+                per_client_rate,
+                consensus_addrs,
+            )
+            c = self._connect(host)
+            c.run(
+                f"cd {self.settings.repo_name} && "
+                f"nohup {node_cmd} > node.log 2>&1 &",
+                hide=True,
+            )
+            c.run(
+                f"cd {self.settings.repo_name} && "
+                f"nohup {client_cmd} > client.log 2>&1 &",
+                hide=True,
+            )
+        time.sleep(bench.duration)
+        self._run_on(hosts, CommandMaker.kill())
+
+    def _logs(self, hosts: list[str], faults: int) -> LogParser:
+        import subprocess
+
+        subprocess.run(CommandMaker.clean_logs(), shell=True, check=True)
+        for i, host in enumerate(hosts):
+            c = self._connect(host)
+            c.get(join(self.settings.repo_name, "node.log"), f"logs/node-{i}.log")
+            c.get(join(self.settings.repo_name, "client.log"), f"logs/client-{i}.log")
+        return LogParser.process("logs", faults)
+
+    def run(self, bench_params: dict, node_params: dict, debug: bool = False) -> None:
+        """Full sweep: nodes x rate x runs (remote.py:249-301)."""
+        bench = BenchParameters(bench_params)
+        params = NodeParameters(node_params)
+        all_hosts = self.manager.hosts(flat=True)
+        for n in bench.nodes:
+            hosts = all_hosts[:n]
+            if len(hosts) < n:
+                raise BenchError(f"only {len(hosts)} hosts available, need {n}")
+            self._update(hosts)
+            self._config(hosts, params)
+            for rate in bench.rate:
+                for run_idx in range(bench.runs):
+                    print(f"run {run_idx}: {n} nodes @ {rate} tx/s")
+                    self._run_single(hosts, rate, bench, debug)
+                    parser = self._logs(hosts, bench.faults)
+                    fname = f"results/bench-{n}-{rate}-{bench.tx_size}-{bench.faults}.txt"
+                    with open(fname, "a") as f:
+                        f.write(parser.result())

@@ -1,0 +1,34 @@
+"""Testbed settings loader (reference benchmark/aws/settings.py:8-60)."""
+
+from __future__ import annotations
+
+import json
+
+
+class SettingsError(Exception):
+    pass
+
+
+class Settings:
+    def __init__(self, obj: dict) -> None:
+        try:
+            self.key_name = obj["key"]["name"]
+            self.key_path = obj["key"]["path"]
+            self.base_port = int(obj["ports"]["consensus"])
+            self.mempool_port = int(obj["ports"]["mempool"])
+            self.front_port = int(obj["ports"]["front"])
+            self.repo_name = obj["repo"]["name"]
+            self.repo_url = obj["repo"]["url"]
+            self.branch = obj["repo"]["branch"]
+            self.instance_type = obj["instances"]["type"]
+            self.aws_regions = obj["instances"]["regions"]
+        except (KeyError, ValueError, TypeError) as e:
+            raise SettingsError(f"malformed settings: {e}") from e
+
+    @classmethod
+    def load(cls, filename: str = "settings.json") -> "Settings":
+        try:
+            with open(filename) as f:
+                return cls(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            raise SettingsError(str(e)) from e

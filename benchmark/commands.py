@@ -1,0 +1,55 @@
+"""Canonical shell command strings (reference benchmark/benchmark/commands.py:6-56).
+
+The reference aliases compiled Rust binaries; here the "binaries" are the
+package entry points run with the current interpreter.
+"""
+
+from __future__ import annotations
+
+import sys
+from os.path import join
+
+
+class CommandMaker:
+    @staticmethod
+    def cleanup() -> str:
+        return "rm -rf .db-* ; rm -f .*.json ; mkdir -p logs"
+
+    @staticmethod
+    def clean_logs() -> str:
+        return "rm -rf logs ; mkdir -p logs"
+
+    @staticmethod
+    def compile() -> str:
+        # No compilation for the Python path; the native plane builds via make.
+        return f"{sys.executable} -c 'import hotstuff_tpu'"
+
+    @staticmethod
+    def generate_key(filename: str) -> str:
+        return f"{sys.executable} -m hotstuff_tpu.node.main keys --filename {filename}"
+
+    @staticmethod
+    def run_node(keys: str, committee: str, store: str, parameters: str, crypto: str = "cpu", debug: bool = False) -> str:
+        v = "-vvv" if debug else "-vv"
+        return (
+            f"{sys.executable} -m hotstuff_tpu.node.main {v} run "
+            f"--keys {keys} --committee {committee} --store {store} "
+            f"--parameters {parameters} --crypto {crypto}"
+        )
+
+    @staticmethod
+    def run_client(address: str, size: int, rate: int, nodes: list[str], duration: float | None = None) -> str:
+        nodes_arg = f" --nodes {' '.join(nodes)}" if nodes else ""
+        dur = f" --duration {duration}" if duration is not None else ""
+        return (
+            f"{sys.executable} -m hotstuff_tpu.node.client -vv {address} "
+            f"--size {size} --rate {rate}{nodes_arg}{dur}"
+        )
+
+    @staticmethod
+    def kill() -> str:
+        return "pkill -f hotstuff_tpu.node || true"
+
+    @staticmethod
+    def logs_path(directory: str, kind: str, i: int) -> str:
+        return join(directory, f"{kind}-{i}.log")

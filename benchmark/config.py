@@ -1,0 +1,101 @@
+"""Benchmark configuration builders (reference benchmark/benchmark/config.py:21-166).
+
+Builds the committee/parameters JSON files the node binary consumes, with the
+LocalCommittee port layout: consensus base+i, mempool base+size+i, front
+base+2*size+i (config.py:101-112).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class ConfigError(Exception):
+    pass
+
+
+class Key:
+    def __init__(self, name: str, secret: str) -> None:
+        self.name = name
+        self.secret = secret
+
+    @classmethod
+    def from_file(cls, filename: str) -> "Key":
+        with open(filename) as f:
+            data = json.load(f)
+        return cls(data["name"], data["secret"])
+
+
+class BenchParameters:
+    """Validated benchmark sweep parameters (config.py:118-146)."""
+
+    def __init__(self, obj: dict) -> None:
+        try:
+            nodes = obj["nodes"]
+            nodes = nodes if isinstance(nodes, list) else [nodes]
+            rate = obj["rate"]
+            rate = rate if isinstance(rate, list) else [rate]
+            self.nodes = [int(x) for x in nodes]
+            self.rate = [int(x) for x in rate]
+            self.tx_size = int(obj["tx_size"])
+            self.faults = int(obj.get("faults", 0))
+            self.duration = int(obj["duration"])
+            self.runs = int(obj.get("runs", 1))
+        except (KeyError, ValueError, TypeError) as e:
+            raise ConfigError(f"malformed bench parameters: {e}") from e
+        if min(self.nodes) <= 1 or min(self.rate) < 0 or self.tx_size < 9:
+            raise ConfigError("invalid bench parameter values")
+
+
+class NodeParameters:
+    """Validates and writes node parameter files (config.py:148-166)."""
+
+    def __init__(self, obj: dict) -> None:
+        self.obj = {"consensus": obj.get("consensus", {}), "mempool": obj.get("mempool", {})}
+
+    def write(self, filename: str) -> None:
+        with open(filename, "w") as f:
+            json.dump(self.obj, f, indent=2, sort_keys=True)
+
+
+class LocalCommittee:
+    """Committee JSON for a localhost testbed (config.py:101-112)."""
+
+    def __init__(self, names: list[str], port: int) -> None:
+        self.names = names
+        self.port = port
+        size = len(names)
+        self.consensus_addr = {
+            n: f"127.0.0.1:{port + i}" for i, n in enumerate(names)
+        }
+        self.mempool_addr = {
+            n: f"127.0.0.1:{port + size + i}" for i, n in enumerate(names)
+        }
+        self.front_addr = {
+            n: f"127.0.0.1:{port + 2 * size + i}" for i, n in enumerate(names)
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "consensus": {
+                "epoch": 1,
+                "authorities": {
+                    n: {"stake": 1, "address": self.consensus_addr[n]}
+                    for n in self.names
+                },
+            },
+            "mempool": {
+                "epoch": 1,
+                "authorities": {
+                    n: {
+                        "front_address": self.front_addr[n],
+                        "mempool_address": self.mempool_addr[n],
+                    }
+                    for n in self.names
+                },
+            },
+        }
+
+    def write(self, filename: str) -> None:
+        with open(filename, "w") as f:
+            json.dump(self.to_json(), f, indent=2, sort_keys=True)

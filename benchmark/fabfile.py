@@ -1,0 +1,131 @@
+"""Benchmark task entry points (reference benchmark/fabfile.py:11-157).
+
+With Fabric installed these are `fab local`, `fab plot`, etc.; without it,
+`python -m benchmark.run_local` drives the same code (this environment has no
+Fabric). Remote/AWS tasks require boto3+fabric and raise a clear error when
+missing.
+"""
+
+from __future__ import annotations
+
+try:  # Fabric is optional (absent in this environment).
+    from fabric import task
+except ImportError:  # pragma: no cover
+
+    def task(fn):
+        return fn
+
+
+from .local import LocalBench
+from .logs import LogParser
+
+# Reference-default local parameters (fabfile.py:14-34).
+LOCAL_BENCH_PARAMS = {
+    "nodes": 4,
+    "rate": 1_000,
+    "tx_size": 512,
+    "faults": 0,
+    "duration": 20,
+}
+LOCAL_NODE_PARAMS = {
+    "consensus": {
+        "timeout_delay": 1_000,
+        "sync_retry_delay": 10_000,
+        "max_payload_size": 1_000,
+        "min_block_delay": 0,
+    },
+    "mempool": {
+        "queue_capacity": 10_000,
+        "sync_retry_delay": 10_000,
+        "max_payload_size": 15_000,
+        "min_block_delay": 0,
+    },
+}
+
+# Reference-default remote sweep (fabfile.py:99-120).
+REMOTE_BENCH_PARAMS = {
+    "nodes": [10, 20],
+    "rate": [25_000, 50_000],
+    "tx_size": 512,
+    "faults": 0,
+    "duration": 300,
+    "runs": 2,
+}
+
+
+@task
+def local(ctx=None, debug=False, crypto="cpu"):
+    """Run a benchmark on localhost (fabfile.py:11-34)."""
+    params = dict(LOCAL_BENCH_PARAMS, crypto=crypto)
+    parser = LocalBench(params, LOCAL_NODE_PARAMS).run(debug=bool(debug))
+    print(parser.result())
+    return parser
+
+
+@task
+def logs(ctx=None, directory="logs", faults=0):
+    """Parse an existing logs directory (fabfile.py:150-157)."""
+    parser = LogParser.process(directory, int(faults))
+    print(parser.result())
+    return parser
+
+
+@task
+def aggregate(ctx=None, directory="results"):
+    """Aggregate result files (reference aggregate.py)."""
+    from .aggregate import aggregate_results
+
+    aggregate_results(directory)
+
+
+@task
+def plot(ctx=None, directory="results"):
+    """Plot aggregated results (reference plot.py)."""
+    from .plot import plot_results
+
+    plot_results(directory)
+
+
+def _require_aws():
+    raise RuntimeError(
+        "remote/AWS tasks need boto3 + fabric, which are not installed in "
+        "this environment; see benchmark/aws/ for the implementation"
+    )
+
+
+@task
+def create(ctx=None, nodes=2):
+    """Create AWS testbed (fabfile.py:36-47)."""
+    from .aws.instance import InstanceManager
+
+    InstanceManager.make().create_instances(int(nodes))
+
+
+@task
+def destroy(ctx=None):
+    from .aws.instance import InstanceManager
+
+    InstanceManager.make().terminate_instances()
+
+
+@task
+def install(ctx=None):
+    from .aws.remote import Bench
+
+    Bench().install()
+
+
+@task
+def remote(ctx=None, debug=False):
+    from .aws.remote import Bench
+
+    Bench().run(REMOTE_BENCH_PARAMS, LOCAL_NODE_PARAMS, debug=bool(debug))
+
+
+@task
+def kill(ctx=None):
+    import subprocess
+
+    from .commands import CommandMaker
+
+    subprocess.run(CommandMaker.kill(), shell=True)

@@ -1,0 +1,135 @@
+"""Local benchmark runner (reference benchmark/benchmark/local.py:37-120).
+
+Boots a committee of node processes plus one client per node on localhost,
+runs for `duration` seconds, kills everything, and parses the logs. The
+reference manages processes with tmux; here plain subprocesses with per-process
+log redirection (logs/node-i.log, logs/client-i.log) serve the same role.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import time
+from os.path import join
+
+from .commands import CommandMaker
+from .config import BenchParameters, LocalCommittee, NodeParameters
+from .logs import LogParser, ParseError
+
+
+class BenchError(Exception):
+    pass
+
+
+class LocalBench:
+    BASE_PORT = 9_000
+
+    def __init__(self, bench_params: dict, node_params: dict) -> None:
+        self.bench = BenchParameters(bench_params)
+        self.node_params = NodeParameters(node_params)
+        self.crypto = bench_params.get("crypto", "cpu")
+        self._procs: list[subprocess.Popen] = []
+
+    def _background_run(self, command: str, log_file: str) -> None:
+        with open(log_file, "w") as out:
+            proc = subprocess.Popen(
+                shlex.split(command),
+                stdout=out,
+                stderr=subprocess.STDOUT,
+                cwd=os.getcwd(),
+                start_new_session=True,
+            )
+        self._procs.append(proc)
+
+    def _kill(self) -> None:
+        for proc in self._procs:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._procs.clear()
+
+    def run(self, debug: bool = False) -> LogParser:
+        nodes = self.bench.nodes[0]
+        rate = self.bench.rate[0]
+        faults = self.bench.faults
+        boot = nodes - faults
+
+        print(f"Running local benchmark: {nodes} nodes ({faults} faults), "
+              f"{rate} tx/s, {self.bench.tx_size} B txs, {self.bench.duration} s, "
+              f"crypto={self.crypto}")
+        subprocess.run(CommandMaker.kill(), shell=True, capture_output=True)
+        subprocess.run(CommandMaker.cleanup(), shell=True, check=True)
+        subprocess.run(CommandMaker.clean_logs(), shell=True, check=True)
+
+        try:
+            # Generate keys and committee (in-process: one interpreter launch
+            # per key is prohibitively slow on small boxes).
+            from hotstuff_tpu.node.config import Secret
+
+            key_files = [f".node-{i}.json" for i in range(nodes)]
+            names = []
+            for f in key_files:
+                secret = Secret.new()
+                secret.write(f)
+                names.append(secret.name.encode_base64())
+            committee = LocalCommittee(names, self.BASE_PORT)
+            committee.write(".committee.json")
+            self.node_params.write(".parameters.json")
+
+            # Boot nodes (skipping `faults` of them -- fault injection by
+            # simply not booting, local.py:75-76).
+            for i in range(boot):
+                cmd = CommandMaker.run_node(
+                    key_files[i],
+                    ".committee.json",
+                    f".db-{i}/log",
+                    ".parameters.json",
+                    crypto=self.crypto,
+                    debug=debug,
+                )
+                self._background_run(cmd, CommandMaker.logs_path("logs", "node", i))
+
+            # Wait until every node reports booted: Python interpreter
+            # startup under CPU contention can take ~10 s on small machines,
+            # and killing before boot would measure nothing.
+            deadline = time.monotonic() + 90
+            pending = set(range(boot))
+            while pending and time.monotonic() < deadline:
+                time.sleep(0.5)
+                for i in list(pending):
+                    try:
+                        with open(CommandMaker.logs_path("logs", "node", i)) as f:
+                            if "successfully booted" in f.read():
+                                pending.discard(i)
+                    except OSError:
+                        pass
+            if pending:
+                raise BenchError(f"nodes {sorted(pending)} never booted")
+
+            # One client per booted node.
+            per_client_rate = max(1, rate // boot)
+            consensus_addrs = [
+                committee.consensus_addr[n] for n in names[:boot]
+            ]
+            for i in range(boot):
+                cmd = CommandMaker.run_client(
+                    committee.front_addr[names[i]],
+                    self.bench.tx_size,
+                    per_client_rate,
+                    consensus_addrs,
+                )
+                self._background_run(cmd, CommandMaker.logs_path("logs", "client", i))
+
+            time.sleep(self.bench.duration)
+            self._kill()
+            time.sleep(0.5)
+            return LogParser.process("logs", faults)
+        except (subprocess.SubprocessError, ParseError, OSError) as e:
+            self._kill()
+            raise BenchError(f"local benchmark failed: {e}") from e
+        finally:
+            self._kill()

@@ -1,0 +1,236 @@
+"""Log parsing: the metrics pipeline (reference benchmark/benchmark/logs.py).
+
+Regex-scrapes node and client logs to compute:
+  * consensus TPS/BPS and latency (block Created -> Committed)
+  * end-to-end TPS/BPS and latency (client sample send -> commit), via the
+    sample-tx -> payload-digest -> block-commit join (logs.py:102-104,173-182)
+  * benchmark-workload verification throughput (the fork's
+    "Verifying OWN/OTHER transaction batch. Size: N" lines -- the
+    votes-verified/sec north-star metric)
+
+Raises ParseError if any log contains a traceback or actor crash, like the
+reference raising on panics (logs.py:71-72,88-89).
+"""
+
+from __future__ import annotations
+
+import re
+from datetime import datetime, timezone
+from glob import glob
+from os.path import join
+from statistics import mean
+
+
+class ParseError(Exception):
+    pass
+
+
+_TS = r"\[(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
+
+
+def _to_posix(ts: str) -> float:
+    return (
+        datetime.strptime(ts, "%Y-%m-%dT%H:%M:%S.%f")
+        .replace(tzinfo=timezone.utc)
+        .timestamp()
+    )
+
+
+def _search_all(pattern: str, text: str) -> list[tuple]:
+    return re.findall(pattern, text, re.MULTILINE)
+
+
+class LogParser:
+    def __init__(self, clients: list[str], nodes: list[str], faults: int = 0) -> None:
+        self.faults = faults
+        self.committee_size = len(nodes) + faults
+
+        for text in clients + nodes:
+            if "Traceback" in text or "actor" in text and "crashed" in text:
+                raise ParseError("node or client log contains a crash")
+
+        # --- client logs ---
+        self.size = 0
+        self.rate = 0
+        self.start = None
+        self.sent_samples: dict[int, float] = {}  # per-client ids are merged
+        self.misses = 0
+        for i, text in enumerate(clients):
+            m = re.search(rf"{_TS}.*Transactions size: (\d+) B", text)
+            if m:
+                self.size = int(m.group(2))
+            m = re.search(rf"{_TS}.*Transactions rate: (\d+) tx/s", text)
+            if m:
+                self.rate += int(m.group(2))
+            m = re.search(rf"{_TS}.*Start sending transactions", text)
+            if m:
+                t = _to_posix(m.group(1))
+                self.start = t if self.start is None else min(self.start, t)
+            for ts, sid in _search_all(
+                rf"{_TS}.*Sending sample transaction (\d+)", text
+            ):
+                # Sample ids collide across clients; key by (client, id).
+                self.sent_samples[(i, int(sid))] = _to_posix(ts)
+            self.misses += len(_search_all(r"rate too high", text))
+
+        # --- node logs ---
+        self.proposals: dict[str, float] = {}  # block digest -> earliest created
+        self.commits: dict[str, float] = {}  # block digest -> earliest commit
+        self.committed_payloads: dict[str, tuple[str, float]] = {}  # payload -> (block, t)
+        self.payload_sizes: dict[str, int] = {}
+        self.sample_to_payload: dict[tuple[int, int], str] = {}
+        self.verif_batches: list[tuple[float, int]] = []  # (t, batch size)
+        self.timeouts = 0
+        self.configs = self._parse_configs(nodes[0] if nodes else "")
+        for node_index, text in enumerate(nodes):
+            for ts, rnd, digest in _search_all(
+                rf"{_TS}.*Created B(\d+)\((\S+?)\)$", text
+            ):
+                t = _to_posix(ts)
+                self.proposals[digest] = min(
+                    self.proposals.get(digest, t), t
+                )
+            for ts, rnd, digest in _search_all(
+                rf"{_TS}.*Committed B(\d+)\((\S+?)\)$", text
+            ):
+                t = _to_posix(ts)
+                self.commits[digest] = min(self.commits.get(digest, t), t)
+            for ts, rnd, digest, payload in _search_all(
+                rf"{_TS}.*Committed B(\d+)\((\S+?)\) -> (\S+)$", text
+            ):
+                t = _to_posix(ts)
+                prev = self.committed_payloads.get(payload)
+                if prev is None or t < prev[1]:
+                    self.committed_payloads[payload] = (digest, t)
+            for ts, payload, size in _search_all(
+                rf"{_TS}.*Payload (\S+) contains (\d+) B", text
+            ):
+                self.payload_sizes[payload] = int(size)
+            for ts, payload, sid in _search_all(
+                rf"{_TS}.*Payload (\S+) contains sample tx (\d+)", text
+            ):
+                # Client index is unknown from node logs; samples are joined
+                # per-id against every client that sent that id (logs.py:102).
+                self.sample_to_payload[int(sid)] = payload
+            for ts, kind, n in _search_all(
+                rf"{_TS}.*Verifying (OWN|OTHER) transaction batch\. Size: (\d+)", text
+            ):
+                self.verif_batches.append((_to_posix(ts), int(n)))
+            self.timeouts += len(_search_all(r"Timeout reached", text))
+
+    @staticmethod
+    def _parse_configs(text: str) -> dict:
+        out = {}
+        for pat, key in [
+            (r"Timeout delay set to (\d+) ms", "timeout_delay"),
+            (r"Sync retry delay set to (\d+) ms", "sync_retry_delay"),
+            (r"Max payload size set to (\d+) B", "max_payload_size"),
+            (r"Min block delay set to (\d+) ms", "min_block_delay"),
+            (r"Queue capacity set to (\d+)", "queue_capacity"),
+        ]:
+            ms = re.findall(pat, text)
+            if ms:
+                out[key] = int(ms[0])
+        return out
+
+    # --- metrics (reference logs.py:149-182) ---
+
+    def consensus_throughput(self) -> tuple[float, float, float]:
+        """(TPS, BPS, duration). Bytes = sizes of committed payloads."""
+        if not self.commits:
+            return 0.0, 0.0, 0.0
+        start = min(self.proposals.values()) if self.proposals else min(self.commits.values())
+        end = max(self.commits.values())
+        duration = max(end - start, 1e-9)
+        bytes_total = sum(
+            self.payload_sizes.get(p, 0) for p in self.committed_payloads
+        )
+        bps = bytes_total / duration
+        tps = bps / self.size if self.size else 0.0
+        return tps, bps, duration
+
+    def consensus_latency(self) -> float:
+        lat = [
+            self.commits[d] - self.proposals[d]
+            for d in self.commits
+            if d in self.proposals
+        ]
+        return mean(lat) if lat else 0.0
+
+    def end_to_end_throughput(self) -> tuple[float, float, float]:
+        if not self.commits or self.start is None:
+            return 0.0, 0.0, 0.0
+        duration = max(max(self.commits.values()) - self.start, 1e-9)
+        bytes_total = sum(
+            self.payload_sizes.get(p, 0) for p in self.committed_payloads
+        )
+        bps = bytes_total / duration
+        tps = bps / self.size if self.size else 0.0
+        return tps, bps, duration
+
+    def end_to_end_latency(self) -> float:
+        lat = []
+        for (client, sid), sent in self.sent_samples.items():
+            payload = self.sample_to_payload.get(sid)
+            if payload is None:
+                continue
+            hit = self.committed_payloads.get(payload)
+            if hit is None:
+                continue
+            lat.append(hit[1] - sent)
+        return mean(lat) if lat else 0.0
+
+    def verification_throughput(self) -> tuple[float, int]:
+        """(verified signatures/sec across the run, total verified) from the
+        fork's batch log lines -- the votes-verified/sec metric."""
+        if not self.verif_batches:
+            return 0.0, 0
+        times = [t for t, _ in self.verif_batches]
+        total = sum(n for _, n in self.verif_batches)
+        duration = max(max(times) - min(times), 1e-9)
+        return total / duration, total
+
+    def result(self) -> str:
+        c_tps, c_bps, _ = self.consensus_throughput()
+        c_lat = self.consensus_latency()
+        e_tps, e_bps, _ = self.end_to_end_throughput()
+        e_lat = self.end_to_end_latency()
+        v_rate, v_total = self.verification_throughput()
+        warn = ""
+        if self.misses:
+            warn += f" WARNING: {self.misses} rate-too-high warnings\n"
+        if self.timeouts > 2:
+            warn += f" WARNING: {self.timeouts} timeouts\n"
+        return (
+            "\n-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f" Committee size: {self.committee_size} nodes\n"
+            f" Faults: {self.faults} nodes\n"
+            f" Input rate: {self.rate:,} tx/s\n"
+            f" Transaction size: {self.size:,} B\n"
+            f" {self.configs}\n"
+            f"{warn}"
+            " + RESULTS:\n"
+            f" Consensus TPS: {round(c_tps):,} tx/s\n"
+            f" Consensus BPS: {round(c_bps):,} B/s\n"
+            f" Consensus latency: {round(c_lat * 1000):,} ms\n"
+            f" End-to-end TPS: {round(e_tps):,} tx/s\n"
+            f" End-to-end BPS: {round(e_bps):,} B/s\n"
+            f" End-to-end latency: {round(e_lat * 1000):,} ms\n"
+            f" Batch verification rate: {round(v_rate):,} sigs/s ({v_total:,} total)\n"
+            "-----------------------------------------\n"
+        )
+
+    @classmethod
+    def process(cls, directory: str, faults: int = 0) -> "LogParser":
+        clients = []
+        for path in sorted(glob(join(directory, "client-*.log"))):
+            with open(path) as f:
+                clients.append(f.read())
+        nodes = []
+        for path in sorted(glob(join(directory, "node-*.log"))):
+            with open(path) as f:
+                nodes.append(f.read())
+        return cls(clients, nodes, faults)

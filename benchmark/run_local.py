@@ -1,0 +1,44 @@
+"""CLI driver for the local benchmark (Fabric-free `fab local`).
+
+    python -m benchmark.run_local --nodes 4 --rate 1000 --size 512 \
+        --duration 20 [--faults 0] [--crypto cpu|tpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .fabfile import LOCAL_NODE_PARAMS
+from .local import LocalBench
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument("--size", type=int, default=512)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--duration", type=int, default=20)
+    p.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--benchmark-workload", action="store_true",
+                   help="enable the fork's synthetic batch-verification workload")
+    p.add_argument("--debug", action="store_true")
+    args = p.parse_args()
+
+    bench_params = {
+        "nodes": args.nodes,
+        "rate": args.rate,
+        "tx_size": args.size,
+        "faults": args.faults,
+        "duration": args.duration,
+        "crypto": args.crypto,
+    }
+    node_params = {k: dict(v) for k, v in LOCAL_NODE_PARAMS.items()}
+    if args.benchmark_workload:
+        node_params["mempool"]["benchmark_mode"] = True
+    parser = LocalBench(bench_params, node_params).run(debug=args.debug)
+    print(parser.result())
+
+
+if __name__ == "__main__":
+    main()

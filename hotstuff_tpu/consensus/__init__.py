@@ -1,0 +1,18 @@
+from .config import Authority, Committee, Parameters
+from .consensus import Consensus
+from .messages import QC, TC, Block, LoopBack, Round, SyncRequest, Timeout, Vote
+
+__all__ = [
+    "Authority",
+    "Committee",
+    "Parameters",
+    "Consensus",
+    "QC",
+    "TC",
+    "Block",
+    "LoopBack",
+    "Round",
+    "SyncRequest",
+    "Timeout",
+    "Vote",
+]

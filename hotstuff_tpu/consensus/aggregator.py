@@ -1,0 +1,88 @@
+"""Vote/timeout aggregation into QCs/TCs (reference consensus/src/aggregator.rs).
+
+QCMaker/TCMaker accumulate stake-weighted signatures, reject duplicate
+authors, and fire EXACTLY ONCE when the quorum threshold is reached
+(aggregator.rs:74-94,113-138). The Aggregator keys makers per (round, digest)
+and drops state for old rounds on cleanup (aggregator.rs:52-70).
+
+This accumulate-then-batch-verify structure is precisely the seam the TPU
+backend exploits: a full QC's signatures are verified as one vmapped batch.
+"""
+
+from __future__ import annotations
+
+from ..crypto import Digest, PublicKey, Signature
+from .config import Committee
+from .errors import UnknownAuthorityError, ensure
+from .messages import QC, TC, Round, Timeout, Vote
+
+
+class QCMaker:
+    """Accumulates votes for one (block digest, round) into a QC."""
+
+    def __init__(self) -> None:
+        self.weight = 0
+        self.votes: list[tuple[PublicKey, Signature]] = []
+        self.used: set[PublicKey] = set()
+
+    def append(self, vote: Vote, committee: Committee) -> QC | None:
+        if vote.author in self.used:
+            return None  # redelivery (retries rebroadcast); not Byzantine
+        stake = committee.stake(vote.author)
+        ensure(stake > 0, UnknownAuthorityError(vote.author))
+        self.used.add(vote.author)
+        self.votes.append((vote.author, vote.signature))
+        self.weight += stake
+        if self.weight >= committee.quorum_threshold():
+            self.weight = 0  # fire exactly once (aggregator.rs:88)
+            return QC(vote.hash, vote.round, tuple(self.votes))
+        return None
+
+
+class TCMaker:
+    """Accumulates timeouts for one round into a TC."""
+
+    def __init__(self) -> None:
+        self.weight = 0
+        self.votes: list[tuple[PublicKey, Signature, Round]] = []
+        self.used: set[PublicKey] = set()
+
+    def append(self, timeout: Timeout, committee: Committee) -> TC | None:
+        if timeout.author in self.used:
+            return None  # redelivery (nodes re-timeout the same round)
+        stake = committee.stake(timeout.author)
+        ensure(stake > 0, UnknownAuthorityError(timeout.author))
+        self.used.add(timeout.author)
+        self.votes.append((timeout.author, timeout.signature, timeout.high_qc.round))
+        self.weight += stake
+        if self.weight >= committee.quorum_threshold():
+            self.weight = 0
+            return TC(timeout.round, tuple(self.votes))
+        return None
+
+
+class Aggregator:
+    def __init__(self, committee: Committee) -> None:
+        self.committee = committee
+        self.votes_aggregators: dict[tuple[Round, Digest], QCMaker] = {}
+        self.timeouts_aggregators: dict[Round, TCMaker] = {}
+
+    def add_vote(self, vote: Vote) -> QC | None:
+        """May raise ConsensusError on Byzantine input (duplicate author).
+        TODO parity note: like the reference (aggregator.rs:29-30), a bad node
+        could grow this map; cleanup() bounds it per round advance."""
+        key = (vote.round, vote.hash)
+        maker = self.votes_aggregators.setdefault(key, QCMaker())
+        return maker.append(vote, self.committee)
+
+    def add_timeout(self, timeout: Timeout) -> TC | None:
+        maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
+        return maker.append(timeout, self.committee)
+
+    def cleanup(self, round_: Round) -> None:
+        self.votes_aggregators = {
+            k: v for k, v in self.votes_aggregators.items() if k[0] >= round_
+        }
+        self.timeouts_aggregators = {
+            k: v for k, v in self.timeouts_aggregators.items() if k >= round_
+        }

@@ -1,0 +1,115 @@
+"""Consensus committee and parameters (reference consensus/src/config.rs).
+
+Quorum math: with total stake N, quorum_threshold = 2N/3 + 1, so any two
+quorums intersect in at least one honest authority when N = 3f+1
+(consensus/src/config.rs:68-73).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..crypto import PublicKey
+from ..network.net import Address
+
+
+@dataclass(slots=True)
+class Authority:
+    stake: int
+    address: Address
+
+
+@dataclass(slots=True)
+class Committee:
+    """Voting authorities for one epoch (consensus/src/config.rs:31-88)."""
+
+    authorities: dict[PublicKey, Authority]
+    epoch: int = 1
+
+    @staticmethod
+    def new(info: list[tuple[PublicKey, int, Address]], epoch: int = 1) -> "Committee":
+        return Committee(
+            {name: Authority(stake, addr) for name, stake, addr in info}, epoch
+        )
+
+    def size(self) -> int:
+        return len(self.authorities)
+
+    def stake(self, name: PublicKey) -> int:
+        auth = self.authorities.get(name)
+        return auth.stake if auth else 0
+
+    def total_votes(self) -> int:
+        return sum(a.stake for a in self.authorities.values())
+
+    def quorum_threshold(self) -> int:
+        # 2N/3 + 1 (ensures any two quorums intersect in an honest node).
+        return 2 * self.total_votes() // 3 + 1
+
+    def address(self, name: PublicKey) -> Address | None:
+        auth = self.authorities.get(name)
+        return auth.address if auth else None
+
+    def broadcast_addresses(self, myself: PublicKey) -> list[Address]:
+        return [
+            a.address for n, a in self.authorities.items() if n != myself
+        ]
+
+    def sorted_keys(self) -> list[PublicKey]:
+        return sorted(self.authorities.keys())
+
+    def to_json(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "authorities": {
+                name.encode_base64(): {
+                    "stake": a.stake,
+                    "address": f"{a.address[0]}:{a.address[1]}",
+                }
+                for name, a in self.authorities.items()
+            },
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "Committee":
+        auths = {}
+        for name_b64, a in obj["authorities"].items():
+            host, port = a["address"].rsplit(":", 1)
+            auths[PublicKey.decode_base64(name_b64)] = Authority(
+                a["stake"], (host, int(port))
+            )
+        return Committee(auths, obj.get("epoch", 1))
+
+
+@dataclass(slots=True)
+class Parameters:
+    """Protocol tuning knobs with the reference defaults
+    (consensus/src/config.rs:18-27)."""
+
+    timeout_delay: int = 5_000  # ms before the pacemaker fires
+    sync_retry_delay: int = 10_000  # ms between sync request retries
+    max_payload_size: int = 500  # max bytes of payload digests per block
+    min_block_delay: int = 100  # ms minimum spacing between blocks
+
+    def log(self, log) -> None:
+        # NOTE: these log entries are parsed by the benchmark LogParser.
+        log.info("Timeout delay set to %s ms", self.timeout_delay)
+        log.info("Sync retry delay set to %s ms", self.sync_retry_delay)
+        log.info("Max payload size set to %s B", self.max_payload_size)
+        log.info("Min block delay set to %s ms", self.min_block_delay)
+
+    def to_json(self) -> dict:
+        return {
+            "timeout_delay": self.timeout_delay,
+            "sync_retry_delay": self.sync_retry_delay,
+            "max_payload_size": self.max_payload_size,
+            "min_block_delay": self.min_block_delay,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "Parameters":
+        p = Parameters()
+        for k in vars(p) if not hasattr(Parameters, "__slots__") else Parameters.__slots__:
+            if k in obj:
+                setattr(p, k, obj[k])
+        return p

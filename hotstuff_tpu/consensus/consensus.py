@@ -1,0 +1,86 @@
+"""Consensus subsystem launcher (reference consensus/src/consensus.rs:20-105):
+wires the net receiver/sender, leader elector, mempool driver, synchronizer,
+and spawns the core state-machine actor.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import PublicKey, SignatureService
+from ..network import NetReceiver, NetSender
+from ..store import Store
+from ..utils.actors import channel, spawn
+from .config import Committee, Parameters
+from .core import Core
+from .leader import LeaderElector
+from .mempool_driver import MempoolDriver
+from .messages import decode_consensus_message
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("hotstuff.consensus")
+
+
+class Consensus:
+    @staticmethod
+    def run(
+        name: PublicKey,
+        committee: Committee,
+        parameters: Parameters,
+        store: Store,
+        signature_service: SignatureService,
+        mempool_channel: asyncio.Queue,
+        commit_channel: asyncio.Queue,
+        core_channel: asyncio.Queue | None = None,
+    ) -> Core:
+        """Boot the consensus plane; returns the Core (its actor task is
+        spawned). The committee addresses are this plane's listen ports.
+        `core_channel` may be supplied by the composition root so other
+        subsystems (the mempool payload synchronizer) can LoopBack blocks
+        into the core (node/src/node.rs:34-89 channel wiring)."""
+        # NOTE: boot-time config echo; parsed by the benchmark harness.
+        parameters.log(log)
+
+        if core_channel is None:
+            core_channel = channel()
+        network_tx = channel()
+
+        address = committee.address(name)
+        assert address is not None, "node must be in the committee"
+        NetReceiver(
+            ("0.0.0.0", address[1]),
+            core_channel,
+            decode=decode_consensus_message,
+            name="consensus-receiver",
+        )
+        NetSender(network_tx, name="consensus-sender")
+
+        leader_elector = LeaderElector(committee)
+        mempool_driver = MempoolDriver(mempool_channel)
+        synchronizer = Synchronizer(
+            name,
+            committee,
+            store,
+            network_tx,
+            core_channel,
+            parameters.sync_retry_delay,
+        )
+        core = Core(
+            name,
+            committee,
+            parameters,
+            signature_service,
+            store,
+            leader_elector,
+            mempool_driver,
+            synchronizer,
+            core_channel,
+            network_tx,
+            commit_channel,
+        )
+        spawn(core.run(), name="consensus-core")
+        log.info(
+            "Consensus node %s successfully booted on %s", name.short(), address
+        )
+        return core

@@ -1,0 +1,364 @@
+"""The 2-chain HotStuff core state machine (reference consensus/src/core.rs).
+
+One actor owns ALL protocol state (round, last_voted_round, high_qc,
+aggregator, pacemaker timer) and processes, via a single select loop
+(core.rs:446-480):
+  * Propose / Vote / Timeout / TC / SyncRequest messages from peers
+  * LoopBack re-injections from the synchronizers
+  * pacemaker timer expiry
+
+Safety rules (core.rs:106-123): vote at most once per round, and only for a
+block extending the latest QC (or justified by a TC). Liveness: the pacemaker
+(timeout -> Timeout -> TC -> round advance with leader rotation).
+
+Commit rule (2-chain, core.rs:344-350): committing b0 requires two blocks in
+consecutive rounds, b0.round + 1 == b1.round, where b1 carries a QC on b0.
+
+Improvement over the reference: the volatile safety state (round,
+last_voted_round, high_qc) is persisted to the store and reloaded on restart,
+closing the double-vote-after-crash gap the reference acknowledges
+(consensus/src/core.rs:121, upstream issue #15).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import Digest, PublicKey, SignatureService
+from ..network.net import NetMessage
+from ..store import Store
+from ..utils.actors import Selector, Timer, spawn
+from ..utils.serde import Reader, Writer
+from .aggregator import Aggregator
+from .config import Committee, Parameters
+from .errors import ConsensusError, WrongLeaderError, ensure
+from .leader import LeaderElector
+from .mempool_driver import MempoolDriver
+from .messages import (
+    QC,
+    TC,
+    Block,
+    LoopBack,
+    Round,
+    SyncRequest,
+    Timeout,
+    Vote,
+    encode_consensus_message,
+)
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("hotstuff.consensus")
+
+_SAFETY_KEY = b"safety-state"
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        parameters: Parameters,
+        signature_service: SignatureService,
+        store: Store,
+        leader_elector: LeaderElector,
+        mempool_driver: MempoolDriver,
+        synchronizer: Synchronizer,
+        core_channel: asyncio.Queue,
+        network_tx: asyncio.Queue,
+        commit_channel: asyncio.Queue,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.parameters = parameters
+        self.signature_service = signature_service
+        self.store = store
+        self.leader_elector = leader_elector
+        self.mempool_driver = mempool_driver
+        self.synchronizer = synchronizer
+        self.core_channel = core_channel
+        self.network_tx = network_tx
+        self.commit_channel = commit_channel
+
+        self.round: Round = 1
+        self.last_voted_round: Round = 0
+        self.last_committed_round: Round = 0
+        self.high_qc: QC = QC.genesis()
+        self.aggregator = Aggregator(committee)
+        self.timer: Timer | None = None  # created inside the running loop
+
+    # -- persistence of safety-critical state (fixes reference issue #15) ----
+
+    async def _load_safety_state(self) -> None:
+        raw = await self.store.read(_SAFETY_KEY)
+        if raw is None:
+            return
+        r = Reader(raw)
+        self.round = r.u64()
+        self.last_voted_round = r.u64()
+        self.last_committed_round = r.u64()
+        self.high_qc = QC.decode(r)
+        log.info(
+            "Recovered safety state: round %s, last_voted %s",
+            self.round,
+            self.last_voted_round,
+        )
+
+    async def _store_safety_state(self) -> None:
+        w = Writer()
+        w.u64(self.round)
+        w.u64(self.last_voted_round)
+        w.u64(self.last_committed_round)
+        self.high_qc.encode(w)
+        await self.store.write(_SAFETY_KEY, w.bytes())
+
+    # -- helpers -------------------------------------------------------------
+
+    async def _transmit(self, msg, to: PublicKey | None) -> None:
+        """Send to one authority, or broadcast to all others when to is None
+        (consensus/src/synchronizer.rs:109-129 transmit helper)."""
+        data = encode_consensus_message(msg)
+        if to is not None:
+            addr = self.committee.address(to)
+            addrs = [addr] if addr else []
+        else:
+            addrs = self.committee.broadcast_addresses(self.name)
+        if addrs:
+            await self.network_tx.put(NetMessage(data, addrs))
+
+    async def _store_block(self, block: Block) -> None:
+        w = Writer()
+        block.encode(w)
+        await self.store.write(block.digest().data, w.bytes())
+
+    # -- voting & committing -------------------------------------------------
+
+    async def _make_vote(self, block: Block) -> Vote | None:
+        """Safety rules (core.rs:106-123)."""
+        safety_rule_1 = block.round > self.last_voted_round
+        safety_rule_2 = block.qc.round + 1 == block.round
+        if block.tc is not None:
+            # TC justification: block jumps rounds but its QC is at least as
+            # high as anything 2f+1 nodes saw when they timed out.
+            ok_tc = (
+                block.tc.round + 1 == block.round
+                and block.qc.round >= max(block.tc.high_qc_rounds())
+            )
+            safety_rule_2 = safety_rule_2 or ok_tc
+        if not (safety_rule_1 and safety_rule_2):
+            return None
+        self.last_voted_round = block.round
+        await self._store_safety_state()
+        digest = block.digest()
+        from .messages import _vote_digest
+
+        signature = await self.signature_service.request_signature(
+            _vote_digest(digest, block.round)
+        )
+        return Vote(digest, block.round, self.name, signature)
+
+    async def _commit(self, block: Block) -> None:
+        """Commit `block` and all uncommitted ancestors, oldest first
+        (core.rs:125-165)."""
+        if self.last_committed_round >= block.round:
+            return
+        to_commit = [block]
+        parent = block
+        while True:
+            parent_digest = parent.parent()
+            if parent.qc.is_genesis():
+                break
+            raw = await self.store.read(parent_digest.data)
+            if raw is None:
+                log.error("missing ancestor during commit of %s", block)
+                break
+            parent = Block.decode(Reader(raw))
+            if parent.round <= self.last_committed_round:
+                break
+            to_commit.append(parent)
+        self.last_committed_round = block.round
+        for b in reversed(to_commit):
+            d = b.digest()
+            # NOTE: These log entries are used to compute performance.
+            log.info("Committed B%s(%s)", b.round, d)
+            for payload_digest in b.payload:
+                log.info("Committed B%s(%s) -> %s", b.round, d, payload_digest)
+            await self.commit_channel.put(b)
+
+    # -- round pacing --------------------------------------------------------
+
+    async def _process_qc(self, qc: QC) -> None:
+        """Adopt a higher QC and advance past its round (core.rs:263-276,321)."""
+        await self._advance_round(qc.round)
+        if qc.round > self.high_qc.round:
+            self.high_qc = qc
+
+    async def _advance_round(self, round_: Round) -> None:
+        if round_ < self.round:
+            return
+        self.round = round_ + 1
+        log.debug("Moved to round %s", self.round)
+        if self.timer is not None:
+            self.timer.reset()
+        self.aggregator.cleanup(self.round)
+        # Round/high_qc persistence piggybacks on the next pre-vote or
+        # pre-timeout safety write (exactly one flushed write per round);
+        # only last_voted_round must be durable BEFORE a signature leaves.
+
+    async def _local_timeout_round(self) -> None:
+        """Pacemaker fired (core.rs:175-197)."""
+        log.warning("Timeout reached for round %s", self.round)
+        self.last_voted_round = max(self.last_voted_round, self.round)
+        await self._store_safety_state()
+        from .messages import _timeout_digest
+
+        signature = await self.signature_service.request_signature(
+            _timeout_digest(self.round, self.high_qc.round)
+        )
+        timeout = Timeout(self.high_qc, self.round, self.name, signature)
+        if self.timer is not None:
+            self.timer.reset()
+        await self._transmit(timeout, None)
+        await self._handle_timeout(timeout)
+
+    # -- proposals -----------------------------------------------------------
+
+    async def _generate_proposal(self, tc: TC | None) -> None:
+        """Leader path (core.rs:278-318)."""
+        payload = await self.mempool_driver.get(self.parameters.max_payload_size)
+        digest = Block.make_digest(self.name, self.round, payload, self.high_qc)
+        signature = await self.signature_service.request_signature(digest)
+        block = Block(
+            self.high_qc, tc, self.name, self.round, tuple(payload), signature
+        )
+        if block.payload:
+            # NOTE: This log entry is used to compute performance.
+            log.info("Created B%s(%s)", block.round, block.digest())
+        else:
+            log.debug("Created empty %s", block)
+        await self._transmit(block, None)
+        await self._process_block(block)
+
+    async def _process_block(self, block: Block) -> None:
+        """Ordering + commit logic (core.rs:327-378)."""
+        ancestors = await self.synchronizer.get_ancestors(block)
+        if ancestors is None:
+            log.debug("processing of %s suspended: missing ancestors", block)
+            return
+        b0, b1 = ancestors
+        await self._store_block(block)
+
+        # 2-chain commit rule.
+        if b0.round + 1 == b1.round:
+            await self._commit(b0)
+        await self.mempool_driver.cleanup(b0, b1, block)
+
+        if block.round != self.round:
+            return
+        if self.timer is not None:
+            self.timer.reset()
+        vote = await self._make_vote(block)
+        if vote is None:
+            return
+        log.debug("created %s", vote)
+        next_leader = self.leader_elector.get_leader(self.round + 1)
+        if next_leader == self.name:
+            await self._handle_vote(vote)
+        else:
+            await self._transmit(vote, next_leader)
+
+    # -- message handlers ----------------------------------------------------
+
+    async def _handle_proposal(self, block: Block) -> None:
+        digest = block.digest()
+        leader = self.leader_elector.get_leader(block.round)
+        ensure(
+            block.author == leader, WrongLeaderError(block.round, block.author, leader)
+        )
+        block.verify(self.committee)
+        await self._process_qc(block.qc)
+        if block.tc is not None:
+            await self._advance_round(block.tc.round)
+        available = await self.mempool_driver.verify(block)
+        if not available:
+            log.debug("%s waiting for payload availability", block)
+            return
+        await self._process_block(block)
+
+    async def _handle_vote(self, vote: Vote) -> None:
+        if vote.round < self.round:
+            return
+        vote.verify(self.committee)
+        qc = self.aggregator.add_vote(vote)
+        if qc is not None:
+            log.debug("assembled %s", qc)
+            await self._process_qc(qc)
+            if self.leader_elector.get_leader(self.round) == self.name:
+                await self._generate_proposal(None)
+
+    async def _handle_timeout(self, timeout: Timeout) -> None:
+        if timeout.round < self.round:
+            return
+        timeout.verify(self.committee)
+        await self._process_qc(timeout.high_qc)
+        tc = self.aggregator.add_timeout(timeout)
+        if tc is not None:
+            log.debug("assembled %s", tc)
+            await self._advance_round(tc.round)
+            await self._transmit(tc, None)
+            if self.leader_elector.get_leader(self.round) == self.name:
+                await self._generate_proposal(tc)
+
+    async def _handle_tc(self, tc: TC) -> None:
+        """A TC received directly (core.rs:438-444)."""
+        tc.verify(self.committee)
+        await self._advance_round(tc.round)
+        if self.leader_elector.get_leader(self.round) == self.name:
+            await self._generate_proposal(tc)
+
+    async def _handle_sync_request(self, request: SyncRequest) -> None:
+        """Re-send a stored block to a lagging peer (core.rs:418-436)."""
+        raw = await self.store.read(request.digest.data)
+        if raw is None:
+            return
+        block = Block.decode(Reader(raw))
+        await self._transmit(block, request.requester)
+
+    # -- main loop -----------------------------------------------------------
+
+    async def run(self) -> None:
+        await self._load_safety_state()
+        self.timer = Timer(self.parameters.timeout_delay)
+
+        # Bootstrap: the round-1 leader proposes immediately (core.rs:446-454).
+        if self.leader_elector.get_leader(self.round) == self.name:
+            await self._generate_proposal(None)
+
+        selector = Selector()
+        selector.add("message", self.core_channel.get)
+        selector.add("timer", self.timer.wait)
+        while True:
+            branch, value = await selector.next()
+            try:
+                if branch == "timer":
+                    # Discard stale expiries that raced a reset() (a message
+                    # advancing the round may have completed the timer branch
+                    # before the reset took effect).
+                    if self.timer.expired():
+                        await self._local_timeout_round()
+                elif isinstance(value, Block):
+                    await self._handle_proposal(value)
+                elif isinstance(value, Vote):
+                    await self._handle_vote(value)
+                elif isinstance(value, Timeout):
+                    await self._handle_timeout(value)
+                elif isinstance(value, TC):
+                    await self._handle_tc(value)
+                elif isinstance(value, SyncRequest):
+                    await self._handle_sync_request(value)
+                elif isinstance(value, LoopBack):
+                    await self._process_block(value.block)
+                else:
+                    log.warning("unexpected core message: %r", value)
+            except ConsensusError as e:
+                log.warning("%s", e)

@@ -1,0 +1,16 @@
+"""Leader election (reference consensus/src/leader.rs:16-20):
+round-robin over the sorted authority keys."""
+
+from __future__ import annotations
+
+from ..crypto import PublicKey
+from .config import Committee
+from .messages import Round
+
+
+class LeaderElector:
+    def __init__(self, committee: Committee) -> None:
+        self._keys: list[PublicKey] = committee.sorted_keys()
+
+    def get_leader(self, round_: Round) -> PublicKey:
+        return self._keys[round_ % len(self._keys)]

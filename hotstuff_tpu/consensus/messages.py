@@ -1,0 +1,409 @@
+"""Consensus wire messages: Block, Vote, QC, Timeout, TC.
+
+Capability parity with reference consensus/src/messages.rs:
+  * Block{qc, tc?, author, round, payload: [Digest], signature}  (:22-76)
+  * Vote{hash, round, author, signature}                         (:120-146)
+  * QC{hash, round, votes: [(pk, sig)]} + quorum verify_batch    (:150-226)
+  * Timeout{high_qc, round, author, signature}                   (:230-265)
+  * TC{round, votes: [(pk, sig, high_qc_round)]}                 (:270-315)
+
+Every signed artifact commits to a domain-separated SHA-512/32 digest of its
+semantic content. A Vote signs the SAME digest a QC later verifies, so 2f+1
+Vote signatures aggregate directly into a QC whose batch verification is the
+TPU hot path (QC.verify -> Signature.verify_batch).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+
+from ..crypto import Digest, PublicKey, SecretKey, Signature, sha512_32
+from ..utils.serde import Reader, SerdeError, Writer
+from .config import Committee
+from .errors import (
+    AuthorityReuseError,
+    InvalidSignatureError,
+    QCRequiresQuorumError,
+    TCRequiresQuorumError,
+    UnknownAuthorityError,
+    ensure,
+)
+
+Round = int  # u64
+
+
+def _vote_digest(hash_: Digest, round_: Round) -> Digest:
+    """Digest signed by a Vote and verified by a QC (must coincide)."""
+    return Digest(sha512_32(b"HSVOTE" + hash_.data + struct.pack("<Q", round_)))
+
+
+def _timeout_digest(round_: Round, high_qc_round: Round) -> Digest:
+    """Digest signed by a Timeout and verified by a TC (must coincide)."""
+    return Digest(
+        sha512_32(b"HSTMO" + struct.pack("<QQ", round_, high_qc_round))
+    )
+
+
+def _encode_votes(w: Writer, votes: list[tuple[PublicKey, Signature]]) -> None:
+    w.seq(
+        votes,
+        lambda wr, v: (wr.fixed(v[0].data, 32), wr.fixed(v[1].data, 64)),
+    )
+
+
+def _decode_votes(r: Reader) -> list[tuple[PublicKey, Signature]]:
+    return r.seq(lambda rd: (PublicKey(rd.fixed(32)), Signature(rd.fixed(64))))
+
+
+@dataclass(frozen=True, slots=True)
+class QC:
+    """Quorum certificate: 2f+1 vote signatures over one block digest
+    (consensus/src/messages.rs:150-226)."""
+
+    hash: Digest
+    round: Round
+    votes: tuple[tuple[PublicKey, Signature], ...]
+
+    @staticmethod
+    def genesis() -> "QC":
+        return QC(Digest.zero(), 0, ())
+
+    def is_genesis(self) -> bool:
+        """Full equality with QC.genesis(): a forged round-0 QC with a
+        non-zero hash must NOT bypass verification (the reference compares
+        against QC::genesis() exactly, consensus/src/messages.rs)."""
+        return self == QC.genesis()
+
+    def signed_digest(self) -> Digest:
+        return _vote_digest(self.hash, self.round)
+
+    def verify(self, committee: Committee) -> None:
+        """Quorum + uniqueness checks, then BATCH signature verification --
+        the per-block crypto hot spot (messages.rs:180-198). Raises on failure."""
+        weight = 0
+        used: set[PublicKey] = set()
+        for name, _ in self.votes:
+            ensure(name not in used, AuthorityReuseError(name))
+            stake = committee.stake(name)
+            ensure(stake > 0, UnknownAuthorityError(name))
+            used.add(name)
+            weight += stake
+        ensure(weight >= committee.quorum_threshold(), QCRequiresQuorumError())
+        ok = Signature.verify_batch(self.signed_digest(), list(self.votes))
+        ensure(ok, InvalidSignatureError("QC batch verification failed"))
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(self.hash.data, 32)
+        w.u64(self.round)
+        _encode_votes(w, list(self.votes))
+
+    @staticmethod
+    def decode(r: Reader) -> "QC":
+        return QC(Digest(r.fixed(32)), r.u64(), tuple(_decode_votes(r)))
+
+    def __str__(self) -> str:
+        return f"QC(B{self.round}({self.hash.short()}), {len(self.votes)} votes)"
+
+
+@dataclass(frozen=True, slots=True)
+class TC:
+    """Timeout certificate: 2f+1 timeout signatures for one round; each vote
+    carries the author's high_qc round (consensus/src/messages.rs:270-315)."""
+
+    round: Round
+    votes: tuple[tuple[PublicKey, Signature, Round], ...]
+
+    def high_qc_rounds(self) -> list[Round]:
+        return [r for _, _, r in self.votes]
+
+    def verify(self, committee: Committee) -> None:
+        weight = 0
+        used: set[PublicKey] = set()
+        for name, _, _ in self.votes:
+            ensure(name not in used, AuthorityReuseError(name))
+            stake = committee.stake(name)
+            ensure(stake > 0, UnknownAuthorityError(name))
+            used.add(name)
+            weight += stake
+        ensure(weight >= committee.quorum_threshold(), TCRequiresQuorumError())
+        # Distinct messages (each binds its own high_qc_round): verify_batch_alt.
+        msgs = [_timeout_digest(self.round, hr).data for _, _, hr in self.votes]
+        pairs = [(pk, sig) for pk, sig, _ in self.votes]
+        ok = Signature.verify_batch_alt(msgs, pairs)
+        ensure(ok, InvalidSignatureError("TC batch verification failed"))
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.round)
+        w.seq(
+            list(self.votes),
+            lambda wr, v: (
+                wr.fixed(v[0].data, 32),
+                wr.fixed(v[1].data, 64),
+                wr.u64(v[2]),
+            ),
+        )
+
+    @staticmethod
+    def decode(r: Reader) -> "TC":
+        round_ = r.u64()
+        votes = r.seq(
+            lambda rd: (PublicKey(rd.fixed(32)), Signature(rd.fixed(64)), rd.u64())
+        )
+        return TC(round_, tuple(votes))
+
+    def __str__(self) -> str:
+        return f"TC(round {self.round}, {len(self.votes)} votes)"
+
+
+@dataclass(frozen=True, slots=True)
+class Block:
+    """A proposal: orders payload DIGESTS only (32 B each); payload bytes are
+    disseminated by the mempool plane (consensus/src/messages.rs:22-117)."""
+
+    qc: QC
+    tc: TC | None
+    author: PublicKey
+    round: Round
+    payload: tuple[Digest, ...]
+    signature: Signature
+
+    @staticmethod
+    def genesis() -> "Block":
+        return Block(
+            QC.genesis(),
+            None,
+            PublicKey(bytes(32)),
+            0,
+            (),
+            Signature(bytes(64)),
+        )
+
+    def is_genesis(self) -> bool:
+        return self.round == 0
+
+    def digest(self) -> Digest:
+        h = b"HSBLOCK" + self.author.data + struct.pack("<Q", self.round)
+        for d in self.payload:
+            h += d.data
+        h += self.qc.hash.data + struct.pack("<Q", self.qc.round)
+        return Digest(sha512_32(h))
+
+    def parent(self) -> Digest:
+        return self.qc.hash
+
+    @staticmethod
+    def make_digest(
+        author: PublicKey, round_: Round, payload: list[Digest], qc: QC
+    ) -> Digest:
+        h = b"HSBLOCK" + author.data + struct.pack("<Q", round_)
+        for d in payload:
+            h += d.data
+        h += qc.hash.data + struct.pack("<Q", qc.round)
+        return Digest(sha512_32(h))
+
+    @staticmethod
+    def new_from_key(
+        qc: QC,
+        tc: TC | None,
+        author: PublicKey,
+        round_: Round,
+        payload: list[Digest],
+        secret: SecretKey,
+    ) -> "Block":
+        """Sync constructor bypassing the SignatureService, as the reference
+        test fixtures do (consensus/src/tests/common.rs:44-61)."""
+        digest = Block.make_digest(author, round_, payload, qc)
+        return Block(qc, tc, author, round_, tuple(payload), Signature.new(digest, secret))
+
+    def verify(self, committee: Committee) -> None:
+        """Ingress checks (consensus/src/messages.rs:55-76): known author with
+        stake, author signature, embedded QC, embedded TC."""
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        ok = self.signature.verify(self.digest(), self.author)
+        ensure(ok, InvalidSignatureError(f"bad block signature B{self.round}"))
+        if not self.qc.is_genesis():
+            self.qc.verify(committee)
+        if self.tc is not None:
+            self.tc.verify(committee)
+
+    def encode(self, w: Writer) -> None:
+        self.qc.encode(w)
+        if self.tc is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            self.tc.encode(w)
+        w.fixed(self.author.data, 32)
+        w.u64(self.round)
+        w.seq(list(self.payload), lambda wr, d: wr.fixed(d.data, 32))
+        w.fixed(self.signature.data, 64)
+
+    @staticmethod
+    def decode(r: Reader) -> "Block":
+        qc = QC.decode(r)
+        tc = TC.decode(r) if r.u8() else None
+        author = PublicKey(r.fixed(32))
+        round_ = r.u64()
+        payload = tuple(r.seq(lambda rd: Digest(rd.fixed(32))))
+        sig = Signature(r.fixed(64))
+        return Block(qc, tc, author, round_, payload, sig)
+
+    def size(self) -> int:
+        w = Writer()
+        self.encode(w)
+        return len(w.bytes())
+
+    def __str__(self) -> str:
+        return f"B{self.round}({self.digest().short()})"
+
+
+@dataclass(frozen=True, slots=True)
+class Vote:
+    """A vote on a block, sent to the NEXT leader
+    (consensus/src/messages.rs:120-146)."""
+
+    hash: Digest
+    round: Round
+    author: PublicKey
+    signature: Signature
+
+    @staticmethod
+    def new_from_key(
+        hash_: Digest, round_: Round, author: PublicKey, secret: SecretKey
+    ) -> "Vote":
+        return Vote(hash_, round_, author, Signature.new(_vote_digest(hash_, round_), secret))
+
+    def signed_digest(self) -> Digest:
+        return _vote_digest(self.hash, self.round)
+
+    def verify(self, committee: Committee) -> None:
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        ok = self.signature.verify(self.signed_digest(), self.author)
+        ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(self.hash.data, 32)
+        w.u64(self.round)
+        w.fixed(self.author.data, 32)
+        w.fixed(self.signature.data, 64)
+
+    @staticmethod
+    def decode(r: Reader) -> "Vote":
+        return Vote(
+            Digest(r.fixed(32)), r.u64(), PublicKey(r.fixed(32)), Signature(r.fixed(64))
+        )
+
+    def __str__(self) -> str:
+        return f"V{self.round}({self.hash.short()})"
+
+
+@dataclass(frozen=True, slots=True)
+class Timeout:
+    """Signed claim that a round timed out, carrying the author's highest QC
+    (consensus/src/messages.rs:230-265)."""
+
+    high_qc: QC
+    round: Round
+    author: PublicKey
+    signature: Signature
+
+    @staticmethod
+    def new_from_key(
+        high_qc: QC, round_: Round, author: PublicKey, secret: SecretKey
+    ) -> "Timeout":
+        digest = _timeout_digest(round_, high_qc.round)
+        return Timeout(high_qc, round_, author, Signature.new(digest, secret))
+
+    def signed_digest(self) -> Digest:
+        return _timeout_digest(self.round, self.high_qc.round)
+
+    def verify(self, committee: Committee) -> None:
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        ok = self.signature.verify(self.signed_digest(), self.author)
+        ensure(ok, InvalidSignatureError(f"bad timeout signature T{self.round}"))
+        if not self.high_qc.is_genesis():
+            self.high_qc.verify(committee)
+
+    def encode(self, w: Writer) -> None:
+        self.high_qc.encode(w)
+        w.u64(self.round)
+        w.fixed(self.author.data, 32)
+        w.fixed(self.signature.data, 64)
+
+    @staticmethod
+    def decode(r: Reader) -> "Timeout":
+        return Timeout(
+            QC.decode(r), r.u64(), PublicKey(r.fixed(32)), Signature(r.fixed(64))
+        )
+
+    def __str__(self) -> str:
+        return f"T{self.round}(high_qc round {self.high_qc.round})"
+
+
+# ---------------------------------------------------------------------------
+# Wire envelope (the reference's ConsensusMessage enum, consensus/src/core.rs).
+
+TAG_PROPOSE = 0
+TAG_VOTE = 1
+TAG_TIMEOUT = 2
+TAG_TC = 3
+TAG_SYNC_REQUEST = 4
+
+
+def encode_consensus_message(msg) -> bytes:
+    w = Writer()
+    if isinstance(msg, Block):
+        w.u8(TAG_PROPOSE)
+        msg.encode(w)
+    elif isinstance(msg, Vote):
+        w.u8(TAG_VOTE)
+        msg.encode(w)
+    elif isinstance(msg, Timeout):
+        w.u8(TAG_TIMEOUT)
+        msg.encode(w)
+    elif isinstance(msg, TC):
+        w.u8(TAG_TC)
+        msg.encode(w)
+    elif isinstance(msg, SyncRequest):
+        w.u8(TAG_SYNC_REQUEST)
+        w.fixed(msg.digest.data, 32)
+        w.fixed(msg.requester.data, 32)
+    else:
+        raise TypeError(f"not a consensus message: {msg!r}")
+    return w.bytes()
+
+
+def decode_consensus_message(data: bytes):
+    r = Reader(data)
+    tag = r.u8()
+    if tag == TAG_PROPOSE:
+        out = Block.decode(r)
+    elif tag == TAG_VOTE:
+        out = Vote.decode(r)
+    elif tag == TAG_TIMEOUT:
+        out = Timeout.decode(r)
+    elif tag == TAG_TC:
+        out = TC.decode(r)
+    elif tag == TAG_SYNC_REQUEST:
+        out = SyncRequest(Digest(r.fixed(32)), PublicKey(r.fixed(32)))
+    else:
+        raise SerdeError(f"unknown consensus tag {tag}")
+    r.expect_done()
+    return out
+
+
+@dataclass(frozen=True, slots=True)
+class SyncRequest:
+    """Ask peers to re-send a missing block (consensus/src/core.rs:418-436)."""
+
+    digest: Digest
+    requester: PublicKey
+
+
+@dataclass(frozen=True, slots=True)
+class LoopBack:
+    """Internal-only: re-inject a block whose dependencies arrived
+    (consensus/src/synchronizer.rs:68-76). Never serialized."""
+
+    block: Block

@@ -1,0 +1,12 @@
+from .config import MempoolCommittee, MempoolParameters
+from .mempool import Mempool
+from .messages import Payload, PayloadRequest, Transaction
+
+__all__ = [
+    "MempoolCommittee",
+    "MempoolParameters",
+    "Mempool",
+    "Payload",
+    "PayloadRequest",
+    "Transaction",
+]

@@ -1,0 +1,129 @@
+"""Mempool committee and parameters (reference mempool/src/config.rs:8-84).
+
+Each authority exposes two mempool-plane addresses: `front_address` (client
+transactions) and `mempool_address` (mempool-to-mempool payload traffic).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import PublicKey
+from ..network.net import Address
+
+
+@dataclass(slots=True)
+class MempoolAuthority:
+    name: PublicKey
+    front_address: Address
+    mempool_address: Address
+
+
+@dataclass(slots=True)
+class MempoolCommittee:
+    authorities: dict[PublicKey, MempoolAuthority]
+    epoch: int = 1
+
+    @staticmethod
+    def new(
+        info: list[tuple[PublicKey, Address, Address]], epoch: int = 1
+    ) -> "MempoolCommittee":
+        return MempoolCommittee(
+            {name: MempoolAuthority(name, front, mem) for name, front, mem in info},
+            epoch,
+        )
+
+    def exists(self, name: PublicKey) -> bool:
+        return name in self.authorities
+
+    def front_address(self, name: PublicKey) -> Address | None:
+        a = self.authorities.get(name)
+        return a.front_address if a else None
+
+    def mempool_address(self, name: PublicKey) -> Address | None:
+        a = self.authorities.get(name)
+        return a.mempool_address if a else None
+
+    def broadcast_addresses(self, myself: PublicKey) -> list[Address]:
+        return [
+            a.mempool_address
+            for n, a in self.authorities.items()
+            if n != myself
+        ]
+
+    def to_json(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "authorities": {
+                n.encode_base64(): {
+                    "front_address": f"{a.front_address[0]}:{a.front_address[1]}",
+                    "mempool_address": f"{a.mempool_address[0]}:{a.mempool_address[1]}",
+                }
+                for n, a in self.authorities.items()
+            },
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "MempoolCommittee":
+        def parse(s: str) -> Address:
+            host, port = s.rsplit(":", 1)
+            return (host, int(port))
+
+        auths = {}
+        for name_b64, a in obj["authorities"].items():
+            pk = PublicKey.decode_base64(name_b64)
+            auths[pk] = MempoolAuthority(
+                pk, parse(a["front_address"]), parse(a["mempool_address"])
+            )
+        return MempoolCommittee(auths, obj.get("epoch", 1))
+
+
+@dataclass(slots=True)
+class MempoolParameters:
+    """Reference defaults (mempool/src/config.rs:15-24), plus the benchmark
+    workload knobs the fork hard-codes (mempool/src/core.rs:68-101)."""
+
+    queue_capacity: int = 10_000
+    sync_retry_delay: int = 10_000
+    max_payload_size: int = 100_000
+    min_block_delay: int = 100
+    # Fork's synthetic batched-signature-verification workload: every
+    # own/others payload triggers a batch verification of len(transactions)
+    # synthetic (message, key, signature) triples. The reference pre-generates
+    # 200_000 triples at startup (mempool/src/core.rs:71-84); the pool size is
+    # configurable here (the per-payload verification WORK is identical --
+    # triples are drawn cyclically from the pool).
+    benchmark_mode: bool = False
+    synthetic_pool_size: int = 10_000
+
+    def log(self, log) -> None:
+        # NOTE: these log entries are parsed by the benchmark harness.
+        log.info("Queue capacity set to %s", self.queue_capacity)
+        log.info("Sync retry delay set to %s ms", self.sync_retry_delay)
+        log.info("Max payload size set to %s B", self.max_payload_size)
+        log.info("Min block delay set to %s ms", self.min_block_delay)
+
+    def to_json(self) -> dict:
+        return {
+            "queue_capacity": self.queue_capacity,
+            "sync_retry_delay": self.sync_retry_delay,
+            "max_payload_size": self.max_payload_size,
+            "min_block_delay": self.min_block_delay,
+            "benchmark_mode": self.benchmark_mode,
+            "synthetic_pool_size": self.synthetic_pool_size,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "MempoolParameters":
+        p = MempoolParameters()
+        for k in (
+            "queue_capacity",
+            "sync_retry_delay",
+            "max_payload_size",
+            "min_block_delay",
+            "benchmark_mode",
+            "synthetic_pool_size",
+        ):
+            if k in obj:
+                setattr(p, k, obj[k])
+        return p

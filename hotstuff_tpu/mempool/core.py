@@ -1,0 +1,244 @@
+"""Mempool core actor (reference mempool/src/core.rs).
+
+Maintains the queue of undelivered payload digests, persists and gossips
+payloads, answers PayloadRequests, and serves the consensus driver
+(Get/Verify/Cleanup). Under benchmark mode it reproduces the fork's injected
+workload: every own/others payload triggers a batched verification of
+len(transactions) synthetic (message, key, signature) triples drawn from a
+pre-generated pool (mempool/src/core.rs:68-101,135-148,211-224) -- this is
+the compute-dense kernel the TPU CryptoBackend accelerates, measured as
+votes-verified/sec.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+
+from ..crypto import Digest, PublicKey, Signature, generate_keypair
+from ..network.net import NetMessage
+from ..store import Store
+from ..utils.actors import Selector, spawn
+from ..utils.serde import Reader, Writer
+from ..consensus.mempool_driver import (
+    MempoolCleanup,
+    MempoolGet,
+    MempoolVerify,
+    PayloadStatus,
+)
+from .config import MempoolCommittee, MempoolParameters
+from .messages import OwnPayload, Payload, PayloadRequest
+from .messages import encode_mempool_message
+from .payload_maker import PayloadMaker
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("hotstuff.mempool")
+
+PAYLOAD_PREFIX = b"payload:"
+
+
+class SyntheticPool:
+    """Pre-generated (message, key, signature) triples for the benchmark
+    workload (mempool/src/core.rs:68-101: 200k at startup in the fork; size is
+    configurable here, drawn cyclically so per-payload work is identical)."""
+
+    def __init__(self, size: int, seed: int = 7) -> None:
+        import random
+
+        rng = random.Random(seed)
+        self.messages: list[bytes] = []
+        self.pairs: list[tuple[PublicKey, Signature]] = []
+        for _ in range(size):
+            pk, sk = generate_keypair(rng)
+            msg = rng.randbytes(32)
+            self.messages.append(msg)
+            self.pairs.append((pk, Signature.new(Digest(msg), sk)))
+        self._cursor = 0
+
+    def take(self, n: int) -> tuple[list[bytes], list[tuple[PublicKey, Signature]]]:
+        msgs, pairs = [], []
+        size = len(self.messages)
+        for _ in range(n):
+            i = self._cursor
+            msgs.append(self.messages[i])
+            pairs.append(self.pairs[i])
+            self._cursor = (i + 1) % size
+        return msgs, pairs
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: MempoolCommittee,
+        parameters: MempoolParameters,
+        store: Store,
+        payload_maker: PayloadMaker,
+        synchronizer: Synchronizer,
+        core_channel: asyncio.Queue,
+        consensus_mempool_channel: asyncio.Queue,
+        network_tx: asyncio.Queue,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.parameters = parameters
+        self.store = store
+        self.payload_maker = payload_maker
+        self.synchronizer = synchronizer
+        self.core_channel = core_channel
+        self.consensus_mempool_channel = consensus_mempool_channel
+        self.network_tx = network_tx
+        # Undelivered payload digests, insertion-ordered (core.rs:50 queue).
+        self.queue: dict[Digest, None] = {}
+        self.pool: SyntheticPool | None = None
+        if parameters.benchmark_mode:
+            log.info(
+                "Generating %s synthetic signatures for the benchmark workload",
+                parameters.synthetic_pool_size,
+            )
+            self.pool = SyntheticPool(parameters.synthetic_pool_size)
+
+    # -- persistence ---------------------------------------------------------
+
+    async def _store_payload(self, payload: Payload) -> None:
+        w = Writer()
+        payload.encode(w)
+        await self.store.write(PAYLOAD_PREFIX + payload.digest().data, w.bytes())
+
+    # -- benchmark workload --------------------------------------------------
+
+    def _verify_synthetic_batch(self, kind: str, n: int) -> None:
+        """The fork's injected hot path (mempool/src/core.rs:135-148,211-224).
+        NOTE: This log entry is used to compute performance."""
+        if self.pool is None or n == 0:
+            return
+        log.info("Verifying %s transaction batch. Size: %s", kind, n)
+        msgs, pairs = self.pool.take(n)
+        ok = Signature.verify_batch_alt(msgs, pairs)
+        if not ok:
+            log.error("synthetic batch verification failed (backend bug?)")
+
+    # -- payload handling ----------------------------------------------------
+
+    async def _handle_own_payload(self, payload: Payload) -> Digest:
+        digest = payload.digest()
+        self._verify_synthetic_batch("OWN", len(payload.transactions))
+        # NOTE: These log entries are used to compute performance.
+        log.info("Payload %s contains %s B", digest, payload.size())
+        for sample_id in payload.sample_tx_ids():
+            log.info("Payload %s contains sample tx %s", digest, sample_id)
+        await self._store_payload(payload)
+        # Share early: disseminate bytes while consensus orders digests later
+        # (core.rs:174-175).
+        addrs = self.committee.broadcast_addresses(self.name)
+        if addrs:
+            await self.network_tx.put(
+                NetMessage(encode_mempool_message(payload), addrs)
+            )
+        self._queue_insert(digest)
+        return digest
+
+    async def _handle_others_payload(self, payload: Payload) -> None:
+        """Byzantine-input checks at ingress (core.rs:193-234)."""
+        if not self.committee.exists(payload.author):
+            log.warning("payload from unknown authority %s", payload.author.short())
+            return
+        if payload.size() > self.parameters.max_payload_size:
+            log.warning("payload exceeds size cap, dropping")
+            return
+        if not payload.verify(self.committee):
+            log.warning("invalid payload signature from %s", payload.author.short())
+            return
+        self._verify_synthetic_batch("OTHER", len(payload.transactions))
+        await self._store_payload(payload)
+        self._queue_insert(payload.digest())
+
+    def _queue_insert(self, digest: Digest) -> None:
+        if len(self.queue) >= self.parameters.queue_capacity:
+            log.warning("mempool queue full, dropping digest")
+            return
+        self.queue[digest] = None
+
+    async def _handle_request(self, request: PayloadRequest) -> None:
+        """Serve stored payloads to a lagging peer (core.rs:236-249)."""
+        addr = self.committee.mempool_address(request.requester)
+        if addr is None:
+            return
+        for digest in request.digests:
+            raw = await self.store.read(PAYLOAD_PREFIX + digest.data)
+            if raw is not None:
+                payload = Payload.decode(Reader(raw))
+                await self.network_tx.put(
+                    NetMessage(encode_mempool_message(payload), [addr])
+                )
+
+    # -- consensus driver ----------------------------------------------------
+
+    async def _get_payload(self, max_size: int) -> list[Digest]:
+        """Pop up to max_size/32 digests; if the queue is dry, force the
+        PayloadMaker to flush (core.rs:251-268)."""
+        limit = max(1, max_size // Digest.SIZE)
+        if self.queue:
+            out = []
+            for digest in list(self.queue):
+                if len(out) >= limit:
+                    break
+                out.append(digest)
+                del self.queue[digest]
+            return out
+        payload = await self.payload_maker.request_make()
+        if not payload.transactions:
+            return []
+        digest = await self._handle_own_payload(payload)
+        del self.queue[digest]  # it is being delivered right now
+        return [digest]
+
+    async def _cleanup(self, msg: MempoolCleanup) -> None:
+        for block in (msg.b0, msg.b1, msg.block):
+            for digest in block.payload:
+                self.queue.pop(digest, None)
+        self.synchronizer.cleanup(msg.b0.round)
+
+    # -- main loop -----------------------------------------------------------
+
+    async def run(self) -> None:
+        selector = Selector()
+        selector.add("net", self.core_channel.get)
+        selector.add("consensus", self.consensus_mempool_channel.get)
+        while True:
+            branch, msg = await selector.next()
+            # Requests carrying a reply future MUST always be resolved, even
+            # on internal errors: the consensus core blocks on the reply in
+            # its single select loop, so a dropped future deadlocks the node.
+            if isinstance(msg, MempoolGet):
+                try:
+                    result = await self._get_payload(msg.max_size)
+                except Exception as e:
+                    log.error("get_payload failed: %r", e)
+                    result = []
+                if not msg.reply.done():
+                    msg.reply.set_result(result)
+                continue
+            if isinstance(msg, MempoolVerify):
+                try:
+                    status = await self.synchronizer.verify_payload(msg.block)
+                except Exception as e:
+                    log.error("verify_payload failed: %r", e)
+                    status = PayloadStatus.WAIT
+                if not msg.reply.done():
+                    msg.reply.set_result(status)
+                continue
+            try:
+                if isinstance(msg, OwnPayload):
+                    await self._handle_own_payload(msg.payload)
+                elif isinstance(msg, Payload):
+                    await self._handle_others_payload(msg)
+                elif isinstance(msg, PayloadRequest):
+                    await self._handle_request(msg)
+                elif isinstance(msg, MempoolCleanup):
+                    await self._cleanup(msg)
+                else:
+                    log.warning("unexpected mempool message: %r", msg)
+            except Exception as e:  # a Byzantine message must not kill the actor
+                log.warning("mempool core error: %r", e)

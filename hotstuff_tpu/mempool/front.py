@@ -1,0 +1,47 @@
+"""Client-facing transaction listener (reference mempool/src/front.rs).
+
+Accepts raw length-delimited transaction bytes from load generators / clients
+and forwards them into the PayloadMaker's channel. No authentication: this is
+the benchmark ingress port, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..network.net import Address, read_frame
+from ..utils.actors import spawn
+
+log = logging.getLogger("hotstuff.mempool")
+
+
+class Front:
+    def __init__(self, address: Address, deliver: asyncio.Queue) -> None:
+        self._address = address
+        self._deliver = deliver
+        spawn(self._run(), name="front")
+
+    async def _run(self) -> None:
+        server = await asyncio.start_server(
+            self._handle, host=self._address[0], port=self._address[1]
+        )
+        log.debug("front listening on %s", self._address)
+        async with server:
+            await server.serve_forever()
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        while True:
+            try:
+                tx = await read_frame(reader)
+            except ConnectionError:
+                break
+            if tx is None:
+                break
+            await self._deliver.put(tx)
+        try:
+            writer.close()
+        except Exception:
+            pass

@@ -1,0 +1,86 @@
+"""Mempool subsystem launcher (reference mempool/src/mempool.rs:21-115):
+wires the Front, net sender/receiver, payload maker, synchronizer, and core.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import PublicKey, SignatureService
+from ..network import NetReceiver, NetSender
+from ..store import Store
+from ..utils.actors import channel, spawn
+from .config import MempoolCommittee, MempoolParameters
+from .core import Core
+from .front import Front
+from .messages import decode_mempool_message
+from .payload_maker import PayloadMaker
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("hotstuff.mempool")
+
+
+class Mempool:
+    @staticmethod
+    def run(
+        name: PublicKey,
+        committee: MempoolCommittee,
+        parameters: MempoolParameters,
+        store: Store,
+        signature_service: SignatureService,
+        consensus_mempool_channel: asyncio.Queue,
+        consensus_channel: asyncio.Queue,
+    ) -> Core:
+        """Boot the mempool plane. `consensus_mempool_channel` carries
+        Get/Verify/Cleanup requests FROM consensus; `consensus_channel` lets
+        the payload synchronizer LoopBack blocks INTO the consensus core."""
+        parameters.log(log)
+
+        core_channel = channel()
+        network_tx = channel()
+        tx_client = channel()
+
+        front_addr = committee.front_address(name)
+        mempool_addr = committee.mempool_address(name)
+        assert front_addr is not None and mempool_addr is not None
+
+        Front(("0.0.0.0", front_addr[1]), tx_client)
+        NetReceiver(
+            ("0.0.0.0", mempool_addr[1]),
+            core_channel,
+            decode=decode_mempool_message,
+            name="mempool-receiver",
+        )
+        NetSender(network_tx, name="mempool-sender")
+
+        payload_maker = PayloadMaker(
+            name,
+            signature_service,
+            parameters.max_payload_size,
+            parameters.min_block_delay,
+            tx_client,
+            core_channel,
+        )
+        synchronizer = Synchronizer(
+            name,
+            committee,
+            store,
+            network_tx,
+            consensus_channel,
+            parameters.sync_retry_delay,
+        )
+        core = Core(
+            name,
+            committee,
+            parameters,
+            store,
+            payload_maker,
+            synchronizer,
+            core_channel,
+            consensus_mempool_channel,
+            network_tx,
+        )
+        spawn(core.run(), name="mempool-core")
+        log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)
+        return core

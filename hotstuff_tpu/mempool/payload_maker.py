@@ -1,0 +1,77 @@
+"""Transaction batching actor (reference mempool/src/payload.rs).
+
+Accumulates client transactions and flushes a signed Payload when the batch
+would exceed max_payload_size (then pauses min_block_delay, pacing block
+production, payload.rs:43-53) or on-demand when consensus needs a payload and
+the queue is empty (`make`, payload.rs:55-63,120).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto import PublicKey, SignatureService
+from ..utils.actors import Selector, channel, spawn
+from .messages import OwnPayload, Payload, Transaction
+
+log = logging.getLogger("hotstuff.mempool")
+
+
+class PayloadMaker:
+    def __init__(
+        self,
+        name: PublicKey,
+        signature_service: SignatureService,
+        max_payload_size: int,
+        min_block_delay: int,
+        tx_in: asyncio.Queue,
+        core_channel: asyncio.Queue,
+    ) -> None:
+        self.name = name
+        self.signature_service = signature_service
+        self.max_payload_size = max_payload_size
+        self.min_block_delay = min_block_delay
+        self.tx_in = tx_in
+        self.core_channel = core_channel
+        self._make_requests: asyncio.Queue = channel()
+        self._buffer: list[Transaction] = []
+        self._size = 0
+        spawn(self._run(), name="payload-maker")
+
+    async def request_make(self) -> Payload:
+        """Force an immediate flush; returns the payload (possibly empty).
+        Used by the mempool core when consensus asks for digests and the
+        queue is dry (mempool/src/core.rs:251-268)."""
+        fut = asyncio.get_running_loop().create_future()
+        await self._make_requests.put(fut)
+        return await fut
+
+    async def _make(self) -> Payload:
+        txs, self._buffer, self._size = self._buffer, [], 0
+        digest = Payload.make_digest(self.name, txs)
+        signature = await self.signature_service.request_signature(digest)
+        return Payload(tuple(txs), self.name, signature)
+
+    async def _run(self) -> None:
+        selector = Selector()
+        selector.add("tx", self.tx_in.get)
+        selector.add("make", self._make_requests.get)
+        while True:
+            branch, value = await selector.next()
+            if branch == "tx":
+                if self._size + len(value) > self.max_payload_size and self._buffer:
+                    payload = await self._make()
+                    await self.core_channel.put(OwnPayload(payload))
+                    # Pace block production (payload.rs:49-52).
+                    await asyncio.sleep(self.min_block_delay / 1000.0)
+                self._buffer.append(value)
+                self._size += len(value)
+                if self._size >= self.max_payload_size:
+                    payload = await self._make()
+                    await self.core_channel.put(OwnPayload(payload))
+                    await asyncio.sleep(self.min_block_delay / 1000.0)
+            else:  # make request
+                payload = await self._make()
+                if not value.cancelled():
+                    value.set_result(payload)

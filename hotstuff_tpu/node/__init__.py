@@ -1,0 +1,4 @@
+from .config import Committee, NodeParameters, Secret
+from .node import Node
+
+__all__ = ["Committee", "NodeParameters", "Secret", "Node"]

@@ -1,0 +1,127 @@
+"""The `node` binary (reference node/src/main.rs:16-92).
+
+Subcommands:
+  * keys --filename F                      -- generate a keypair file
+  * run --keys K --committee C --store S [--parameters P] [--crypto cpu|tpu]
+  * deploy --nodes N                       -- in-process local testbed on
+    ports 7000/7100/7200 (node/src/main.rs:94-153)
+
+The --crypto flag selects the CryptoBackend (the BASELINE `fab ...
+--crypto=...` requirement): `cpu` (OpenSSL ed25519 baseline) or `tpu`
+(vmapped JAX batch verification).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from ..utils.logging import setup_logging
+
+
+def _cmd_keys(args) -> None:
+    from .config import Secret
+
+    Secret.new().write(args.filename)
+    print(f"Wrote keypair to {args.filename}")
+
+
+async def _run_node(args) -> None:
+    from .node import Node
+
+    if args.crypto != "cpu":
+        from ..crypto.backend import make_backend, set_backend
+
+        set_backend(make_backend(args.crypto))
+    node = Node(args.committee, args.keys, args.store, args.parameters)
+    node.boot()
+    await node.analyze_block()
+
+
+async def _deploy_testbed(args) -> None:
+    """In-process local testbed (node/src/main.rs:94-153): N nodes on
+    localhost ports consensus 7000+i, mempool 7100+i, front 7200+i."""
+    import random
+
+    from ..consensus.config import Committee as CCommittee
+    from ..consensus.config import Parameters as CParameters
+    from ..crypto import SignatureService, generate_keypair
+    from ..mempool.config import MempoolCommittee, MempoolParameters
+    from ..mempool import Mempool
+    from ..consensus import Consensus
+    from ..store import Store
+    from ..utils.actors import channel, spawn
+
+    n = args.nodes
+    rng = random.Random(0)
+    keys = [generate_keypair(rng) for _ in range(n)]
+    consensus_committee = CCommittee.new(
+        [(pk, 1, ("127.0.0.1", 7000 + i)) for i, (pk, _) in enumerate(keys)]
+    )
+    mempool_committee = MempoolCommittee.new(
+        [
+            (pk, ("127.0.0.1", 7200 + i), ("127.0.0.1", 7100 + i))
+            for i, (pk, _) in enumerate(keys)
+        ]
+    )
+    nodes = []
+    for i, (pk, sk) in enumerate(keys):
+        store = Store(f".db_{i}/log")
+        sig = SignatureService(sk)
+        cm_channel = channel()
+        core_channel = channel()
+        commit_channel = channel()
+        Mempool.run(
+            pk, mempool_committee, MempoolParameters(), store, sig, cm_channel, core_channel
+        )
+        Consensus.run(
+            pk,
+            consensus_committee,
+            CParameters(),
+            store,
+            sig,
+            cm_channel,
+            commit_channel,
+            core_channel=core_channel,
+        )
+        nodes.append(commit_channel)
+
+    async def drain(ch):
+        while True:
+            await ch.get()
+
+    await asyncio.gather(*(drain(c) for c in nodes))
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="node", description=__doc__)
+    parser.add_argument("-v", "--verbose", action="count", default=2)
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_keys = sub.add_parser("keys", help="generate a keypair file")
+    p_keys.add_argument("--filename", required=True)
+
+    p_run = sub.add_parser("run", help="run a node")
+    p_run.add_argument("--keys", required=True)
+    p_run.add_argument("--committee", required=True)
+    p_run.add_argument("--parameters", default=None)
+    p_run.add_argument("--store", required=True)
+    p_run.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
+
+    p_deploy = sub.add_parser("deploy", help="in-process local testbed")
+    p_deploy.add_argument("--nodes", type=int, required=True)
+
+    args = parser.parse_args(argv)
+    setup_logging(args.verbose)
+
+    if args.command == "keys":
+        _cmd_keys(args)
+    elif args.command == "run":
+        asyncio.run(_run_node(args))
+    elif args.command == "deploy":
+        asyncio.run(_deploy_testbed(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Node composition root (reference node/src/node.rs:34-99): reads configs,
+builds the store and signing actor, wires the cross-subsystem channels, and
+boots Mempool then Consensus. `analyze_block` drains the commit channel (the
+application layer stub the reference also has, node/src/node.rs:95-99).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..consensus import Consensus
+from ..crypto import SignatureService
+from ..mempool import Mempool
+from ..store import Store
+from ..utils.actors import channel
+from .config import Committee, NodeParameters, Secret
+
+log = logging.getLogger("hotstuff.node")
+
+
+class Node:
+    def __init__(
+        self,
+        committee_path: str,
+        key_path: str,
+        store_path: str,
+        parameters_path: str | None = None,
+    ) -> None:
+        self.committee = Committee.read(committee_path)
+        self.secret = Secret.read(key_path)
+        self.parameters = (
+            NodeParameters.read(parameters_path)
+            if parameters_path
+            else NodeParameters.default()
+        )
+        self.store_path = store_path
+        self.commit_channel = channel()
+
+    def boot(self) -> None:
+        """Must run inside an event loop (actors spawn on construction)."""
+        name = self.secret.name
+        store = Store(self.store_path)
+        signature_service = SignatureService(self.secret.secret)
+        consensus_mempool_channel = channel()
+        consensus_core_channel = channel()
+
+        Mempool.run(
+            name,
+            self.committee.mempool,
+            self.parameters.mempool,
+            store,
+            signature_service,
+            consensus_mempool_channel,
+            consensus_core_channel,
+        )
+        Consensus.run(
+            name,
+            self.committee.consensus,
+            self.parameters.consensus,
+            store,
+            signature_service,
+            consensus_mempool_channel,
+            self.commit_channel,
+            core_channel=consensus_core_channel,
+        )
+        log.info("Node %s successfully booted", name.short())
+
+    async def analyze_block(self) -> None:
+        """Application layer: drain committed blocks (node/src/node.rs:95-99)."""
+        while True:
+            _block = await self.commit_channel.get()
+            # Here the application would execute the ordered transactions.

@@ -91,32 +91,32 @@ class Selector:
 
 
 class Timer:
-    """Resettable timer (reference consensus/src/timer.rs:10-34): a future that
-    resolves `delay_ms` after the last reset(). Used by the pacemaker."""
+    """Resettable timer (reference consensus/src/timer.rs:10-34): `wait()`
+    resolves `delay_ms` after the most recent reset(). Deadline-based so that
+    a wait() armed BEFORE a reset still honours the new deadline (an
+    event-based version orphans pending waiters on reset, silently killing
+    the pacemaker of any replica that processed a block)."""
 
     def __init__(self, delay_ms: int) -> None:
         self._delay = delay_ms / 1000.0
-        self._generation = 0
-        self._fired = asyncio.Event()
-        self._handle: asyncio.TimerHandle | None = None
+        self._deadline = 0.0
         self.reset()
 
     def reset(self) -> None:
-        self._generation += 1
-        gen = self._generation
-        self._fired = asyncio.Event()
-        if self._handle is not None:
-            self._handle.cancel()
         loop = asyncio.get_event_loop()
-        self._handle = loop.call_later(self._delay, self._fire, gen)
+        self._deadline = loop.time() + self._delay
 
-    def _fire(self, gen: int) -> None:
-        if gen == self._generation:
-            self._fired.set()
+    def expired(self) -> bool:
+        """True iff the CURRENT deadline has passed. Consumers multiplexing
+        wait() with message channels must re-check this when the timer branch
+        wins: a completed wait() may predate a reset() that raced it (a stale
+        expiry must not fire a timeout for the new round)."""
+        return asyncio.get_event_loop().time() >= self._deadline
 
     async def wait(self) -> None:
-        await self._fired.wait()
-
-    def cancel(self) -> None:
-        if self._handle is not None:
-            self._handle.cancel()
+        loop = asyncio.get_running_loop()
+        while True:
+            remaining = self._deadline - loop.time()
+            if remaining <= 0:
+                return
+            await asyncio.sleep(remaining)

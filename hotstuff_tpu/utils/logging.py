@@ -1,0 +1,36 @@
+"""Log formatting with millisecond UTC timestamps (reference enables ms
+timestamps under the benchmark feature, node/src/main.rs:51-52). The line
+format is the benchmark LogParser's contract:
+
+    [2026-07-29T12:34:56.789Z INFO hotstuff.consensus] Committed B5(...)
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+
+class UtcMsFormatter(logging.Formatter):
+    converter = time.gmtime
+
+    def formatTime(self, record, datefmt=None):
+        ct = self.converter(record.created)
+        return f"{time.strftime('%Y-%m-%dT%H:%M:%S', ct)}.{int(record.msecs):03d}Z"
+
+
+def setup_logging(verbosity: int = 2, stream=None) -> None:
+    """-v count -> level, like env_logger (node/src/main.rs:43-53):
+    0=ERROR, 1=WARNING, 2=INFO, 3+=DEBUG. Logs go to stderr."""
+    level = [logging.ERROR, logging.WARNING, logging.INFO][min(verbosity, 2)]
+    if verbosity >= 3:
+        level = logging.DEBUG
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(
+        UtcMsFormatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
+    )
+    root = logging.getLogger()
+    root.handlers.clear()
+    root.addHandler(handler)
+    root.setLevel(level)

@@ -1,19 +1,95 @@
 """Shared deterministic fixtures, mirroring the reference's tests/common.rs:
-4 keypairs from a fixed seed (consensus/src/tests/common.rs:13-16) and sync
-builders for blocks/votes/QCs that bypass the async SignatureService
-(consensus/src/tests/common.rs:44-113)."""
+4 keypairs from a fixed seed (consensus/src/tests/common.rs:13-16), committee
+builders, a valid 2-chain builder (:152-184), and a MockMempool that isolates
+consensus from the mempool subsystem (:187-208)."""
 
 from __future__ import annotations
 
+import asyncio
 import random
 
-from hotstuff_tpu.crypto import Digest, PublicKey, SecretKey, Signature
+from hotstuff_tpu.consensus import Block, Committee, Vote, QC
+from hotstuff_tpu.consensus.mempool_driver import (
+    MempoolCleanup,
+    MempoolGet,
+    MempoolVerify,
+    PayloadStatus,
+)
+from hotstuff_tpu.crypto import Digest, PublicKey, SecretKey, Signature, generate_keypair
+from hotstuff_tpu.utils.actors import channel, spawn
 
 SEED = 0
 
 
 def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
     rng = random.Random(SEED)
-    from hotstuff_tpu.crypto import generate_keypair
-
     return [generate_keypair(rng) for _ in range(n)]
+
+
+def committee(base_port: int = 0, n: int = 4) -> Committee:
+    """Committee of n equal-stake authorities on consecutive localhost ports
+    (consensus/src/tests/common.rs:19-31)."""
+    return Committee.new(
+        [
+            (pk, 1, ("127.0.0.1", base_port + i))
+            for i, (pk, _) in enumerate(keys(n))
+        ]
+    )
+
+
+def _secret_of(author: PublicKey) -> SecretKey:
+    for pk, sk in keys():
+        if pk == author:
+            return sk
+    raise KeyError(author)
+
+
+def qc_for(block: Block, signers=None) -> QC:
+    """A QC on `block` signed by `signers` (default: all 4 fixture keys)."""
+    digest = block.digest()
+    votes = []
+    for pk, sk in signers or keys():
+        v = Vote.new_from_key(digest, block.round, pk, sk)
+        votes.append((pk, v.signature))
+    return QC(digest, block.round, tuple(votes))
+
+
+def chain(n: int, cmt: Committee) -> list[Block]:
+    """A valid chain of n blocks for rounds 1..n: each authored by that
+    round's leader and carrying a QC on its parent signed by all keys
+    (consensus/src/tests/common.rs:152-184)."""
+    from hotstuff_tpu.consensus.leader import LeaderElector
+
+    elector = LeaderElector(cmt)
+    blocks: list[Block] = []
+    qc = QC.genesis()
+    for r in range(1, n + 1):
+        leader = elector.get_leader(r)
+        payload = [Digest.of(f"tx-{r}".encode())]
+        block = Block.new_from_key(qc, None, leader, r, payload, _secret_of(leader))
+        blocks.append(block)
+        qc = qc_for(block)
+    return blocks
+
+
+class MockMempool:
+    """Answers Get with one random digest and Verify with Accept
+    (consensus/src/tests/common.rs:187-208)."""
+
+    def __init__(self) -> None:
+        self.channel = channel()
+        self._rng = random.Random(12345)
+        self.cleanups: list[MempoolCleanup] = []
+
+    def start(self) -> None:
+        spawn(self._run(), name="mock-mempool")
+
+    async def _run(self) -> None:
+        while True:
+            msg = await self.channel.get()
+            if isinstance(msg, MempoolGet):
+                msg.reply.set_result([Digest(self._rng.randbytes(32))])
+            elif isinstance(msg, MempoolVerify):
+                msg.reply.set_result(PayloadStatus.ACCEPT)
+            elif isinstance(msg, MempoolCleanup):
+                self.cleanups.append(msg)

@@ -1,0 +1,17 @@
+"""Mempool test fixtures (mirroring mempool/src/tests/common.rs)."""
+
+from __future__ import annotations
+
+from hotstuff_tpu.mempool import MempoolCommittee
+from tests.common import keys
+
+
+def mempool_committee(base_port: int, n: int = 4) -> MempoolCommittee:
+    """front ports base..base+n-1, mempool ports base+n..base+2n-1 (the
+    LocalCommittee port layout, benchmark/benchmark/config.py:101-112)."""
+    return MempoolCommittee.new(
+        [
+            (pk, ("127.0.0.1", base_port + i), ("127.0.0.1", base_port + n + i))
+            for i, (pk, _) in enumerate(keys(n))
+        ]
+    )

@@ -1,0 +1,67 @@
+"""Aggregator tests, mirroring consensus/src/tests/aggregator_tests.rs:
+QC fires exactly once at quorum, duplicate authors rejected, cleanup drops
+old rounds."""
+
+import pytest
+
+from hotstuff_tpu.consensus.aggregator import Aggregator
+from hotstuff_tpu.consensus.errors import AuthorityReuseError
+from hotstuff_tpu.consensus.messages import Timeout, Vote
+from tests.common import chain, committee, keys, qc_for
+
+
+def _votes_for(block):
+    return [Vote.new_from_key(block.digest(), block.round, pk, sk) for pk, sk in keys()]
+
+
+def test_qc_fires_exactly_once_at_quorum():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    agg = Aggregator(cmt)
+    votes = _votes_for(b1)
+    assert agg.add_vote(votes[0]) is None
+    assert agg.add_vote(votes[1]) is None
+    qc = agg.add_vote(votes[2])  # quorum = 3 of 4
+    assert qc is not None
+    qc.verify(cmt)
+    assert agg.add_vote(votes[3]) is None  # never fires twice
+
+
+def test_duplicate_vote_ignored():
+    """Redelivered votes (sync retries, rebroadcasts) are no-ops: they never
+    double-count stake and never raise (the strict duplicate-authority check
+    lives in QC.verify for assembled certificates)."""
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    agg = Aggregator(cmt)
+    votes = _votes_for(b1)
+    agg.add_vote(votes[0])
+    assert agg.add_vote(votes[0]) is None
+    assert agg.add_vote(votes[1]) is None
+    # Third distinct author still completes the quorum of 3.
+    assert agg.add_vote(votes[2]) is not None
+
+
+def test_tc_at_quorum():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    qc = qc_for(b1)
+    agg = Aggregator(cmt)
+    touts = [Timeout.new_from_key(qc, 5, pk, sk) for pk, sk in keys()]
+    assert agg.add_timeout(touts[0]) is None
+    assert agg.add_timeout(touts[1]) is None
+    tc = agg.add_timeout(touts[2])
+    assert tc is not None and tc.round == 5
+    tc.verify(cmt)
+
+
+def test_cleanup_drops_old_rounds():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    agg = Aggregator(cmt)
+    votes = _votes_for(b1)
+    agg.add_vote(votes[0])
+    agg.cleanup(10)
+    assert not agg.votes_aggregators
+    # After cleanup, earlier vote was dropped; re-adding works from scratch.
+    assert agg.add_vote(votes[0]) is None

@@ -1,0 +1,184 @@
+"""Consensus core unit tests, mirroring consensus/src/tests/core_tests.rs:
+drive a real Core by channel injection and assert on emitted NetMessages
+(decoded) and recipients. No TCP involved: the network tx queue is held by
+the test."""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.consensus import Block, Committee, Parameters, Vote
+from hotstuff_tpu.consensus.core import Core
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.mempool_driver import MempoolDriver
+from hotstuff_tpu.consensus.messages import (
+    Timeout,
+    decode_consensus_message,
+)
+from hotstuff_tpu.consensus.synchronizer import Synchronizer
+from hotstuff_tpu.crypto import SignatureService
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.actors import channel, spawn
+from tests.common import MockMempool, chain, committee, keys, qc_for
+
+
+def make_core(name_index: int, cmt: Committee, timeout_ms: int = 2_000):
+    """Build a Core whose channels are all held by the test."""
+    pk, sk = keys()[name_index]
+    store = Store()
+    sig_service = SignatureService(sk)
+    mock = MockMempool()
+    mock.start()
+    core_channel = channel()
+    network_tx = channel()
+    commit_channel = channel()
+    params = Parameters(timeout_delay=timeout_ms)
+    sync = Synchronizer(pk, cmt, store, network_tx, core_channel, params.sync_retry_delay)
+    core = Core(
+        pk,
+        cmt,
+        params,
+        sig_service,
+        store,
+        LeaderElector(cmt),
+        MempoolDriver(mock.channel),
+        sync,
+        core_channel,
+        network_tx,
+        commit_channel,
+    )
+    return core, core_channel, network_tx, commit_channel
+
+
+def test_handle_proposal_emits_vote_to_next_leader(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        elector = LeaderElector(cmt)
+        b1 = chain(1, cmt)[0]
+        # Pick a node that is neither the round-1 proposer nor the round-2
+        # leader, so the vote goes out on the network.
+        next_leader = elector.get_leader(2)
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (b1.author, next_leader)
+        )
+        core, core_channel, network_tx, _ = make_core(idx, cmt)
+        spawn(core.run())
+        await core_channel.put(b1)
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        vote = decode_consensus_message(msg.data)
+        assert isinstance(vote, Vote)
+        assert vote.hash == b1.digest() and vote.round == 1
+        assert msg.addresses == [cmt.address(next_leader)]
+
+    run_async(body())
+
+
+def test_generate_proposal_on_qc(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        elector = LeaderElector(cmt)
+        b1 = chain(1, cmt)[0]
+        # The round-2 leader aggregates votes for b1 into a QC and proposes.
+        leader2 = elector.get_leader(2)
+        idx = next(i for i, (pk, _) in enumerate(keys()) if pk == leader2)
+        core, core_channel, network_tx, _ = make_core(idx, cmt)
+        spawn(core.run())
+        for pk, sk in keys():
+            await core_channel.put(Vote.new_from_key(b1.digest(), 1, pk, sk))
+        while True:
+            msg = await asyncio.wait_for(network_tx.get(), 10)
+            out = decode_consensus_message(msg.data)
+            if isinstance(out, Block):
+                break
+        assert out.round == 2
+        assert out.qc.hash == b1.digest()
+        assert out.author == leader2
+        out.qc.verify(cmt)
+
+    run_async(body())
+
+
+def test_commit_on_two_chain(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        b1, b2, b3 = chain(3, cmt)
+        # Feed the chain in order to a non-leader node: processing b3 gives
+        # ancestors (b1, b2) in consecutive rounds -> b1 commits.
+        idx = next(
+            i for i, (pk, _) in enumerate(keys()) if pk not in (b3.author,)
+        )
+        core, core_channel, _, commit_channel = make_core(idx, cmt)
+        spawn(core.run())
+        for b in (b1, b2, b3):
+            await core_channel.put(b)
+        committed = await asyncio.wait_for(commit_channel.get(), 10)
+        assert committed == b1
+
+    run_async(body())
+
+
+def test_local_timeout_broadcasts_timeout(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        core, _, network_tx, _ = make_core(2, cmt, timeout_ms=200)
+        spawn(core.run())
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        out = decode_consensus_message(msg.data)
+        assert isinstance(out, Timeout)
+        assert out.round == 1
+        assert set(msg.addresses) == set(
+            cmt.broadcast_addresses(keys()[2][0])
+        )
+
+    run_async(body())
+
+
+def test_proposal_from_wrong_leader_ignored(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        b1 = chain(1, cmt)[0]
+        wrong_author_pk, wrong_author_sk = next(
+            (pk, sk) for pk, sk in keys() if pk != b1.author
+        )
+        bad = Block.new_from_key(
+            b1.qc, None, wrong_author_pk, 1, list(b1.payload), wrong_author_sk
+        )
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (wrong_author_pk, LeaderElector(cmt).get_leader(2))
+        )
+        core, core_channel, network_tx, _ = make_core(idx, cmt)
+        spawn(core.run())
+        await core_channel.put(bad)
+        await core_channel.put(b1)  # the real proposal still gets a vote
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        vote = decode_consensus_message(msg.data)
+        assert isinstance(vote, Vote) and vote.hash == b1.digest()
+
+    run_async(body())
+
+
+def test_no_double_vote_same_round(run_async, base_port):
+    async def body():
+        cmt = committee(base_port)
+        b1 = chain(1, cmt)[0]
+        elector = LeaderElector(cmt)
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (b1.author, elector.get_leader(2))
+        )
+        core, core_channel, network_tx, _ = make_core(idx, cmt)
+        spawn(core.run())
+        await core_channel.put(b1)
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        assert isinstance(decode_consensus_message(msg.data), Vote)
+        # Replay the same proposal: safety rule 1 forbids a second vote.
+        await core_channel.put(b1)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(network_tx.get(), 0.5)
+
+    run_async(body())

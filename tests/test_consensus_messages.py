@@ -1,0 +1,136 @@
+"""Message verification tests, mirroring consensus/src/tests/messages_tests.rs:
+QC quorum/authority-reuse/unknown-authority paths, block/vote/timeout/TC
+verification, and wire round-trips."""
+
+import pytest
+
+from hotstuff_tpu.consensus import QC, TC, Block, Timeout, Vote
+from hotstuff_tpu.consensus.errors import (
+    AuthorityReuseError,
+    ConsensusError,
+    InvalidSignatureError,
+    QCRequiresQuorumError,
+    UnknownAuthorityError,
+)
+from hotstuff_tpu.consensus.messages import (
+    decode_consensus_message,
+    encode_consensus_message,
+)
+from hotstuff_tpu.crypto import Digest, Signature, generate_production_keypair
+from hotstuff_tpu.utils.serde import Reader, Writer
+from tests.common import chain, committee, keys, qc_for
+
+
+def test_verify_valid_qc():
+    cmt = committee()
+    blocks = chain(1, cmt)
+    qc = qc_for(blocks[0])
+    qc.verify(cmt)  # must not raise
+
+
+def test_qc_authority_reuse():
+    cmt = committee()
+    blocks = chain(1, cmt)
+    qc = qc_for(blocks[0])
+    votes = list(qc.votes)
+    votes[1] = votes[0]  # duplicate authority
+    with pytest.raises(AuthorityReuseError):
+        QC(qc.hash, qc.round, tuple(votes)).verify(cmt)
+
+
+def test_qc_unknown_authority():
+    cmt = committee()
+    blocks = chain(1, cmt)
+    qc = qc_for(blocks[0])
+    unknown_pk, _ = generate_production_keypair()
+    votes = list(qc.votes)
+    votes[0] = (unknown_pk, votes[0][1])
+    with pytest.raises(UnknownAuthorityError):
+        QC(qc.hash, qc.round, tuple(votes)).verify(cmt)
+
+
+def test_qc_insufficient_stake():
+    cmt = committee()
+    blocks = chain(1, cmt)
+    qc = qc_for(blocks[0], signers=keys()[:2])  # 2 of 4 < quorum (3)
+    with pytest.raises(QCRequiresQuorumError):
+        qc.verify(cmt)
+
+
+def test_qc_bad_signature():
+    cmt = committee()
+    blocks = chain(1, cmt)
+    qc = qc_for(blocks[0])
+    votes = list(qc.votes)
+    votes[0] = (votes[0][0], Signature(bytes(64)))
+    with pytest.raises(InvalidSignatureError):
+        QC(qc.hash, qc.round, tuple(votes)).verify(cmt)
+
+
+def test_block_verify_and_roundtrip():
+    cmt = committee()
+    b1, b2 = chain(2, cmt)
+    b1.verify(cmt)
+    b2.verify(cmt)  # verifies embedded QC too
+    data = encode_consensus_message(b2)
+    decoded = decode_consensus_message(data)
+    assert decoded == b2
+    assert decoded.digest() == b2.digest()
+
+
+def test_block_tampered_signature_rejected():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    bad = Block(b1.qc, b1.tc, b1.author, b1.round, b1.payload, Signature(bytes(64)))
+    with pytest.raises(InvalidSignatureError):
+        bad.verify(cmt)
+
+
+def test_vote_roundtrip_and_verify():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    pk, sk = keys()[0]
+    vote = Vote.new_from_key(b1.digest(), 1, pk, sk)
+    vote.verify(cmt)
+    assert decode_consensus_message(encode_consensus_message(vote)) == vote
+
+
+def test_timeout_and_tc():
+    cmt = committee()
+    (b1,) = chain(1, cmt)
+    qc = qc_for(b1)
+    timeouts = [
+        Timeout.new_from_key(qc, 2, pk, sk) for pk, sk in keys()[:3]
+    ]
+    for t in timeouts:
+        t.verify(cmt)
+        assert decode_consensus_message(encode_consensus_message(t)) == t
+    tc = TC(2, tuple((t.author, t.signature, t.high_qc.round) for t in timeouts))
+    tc.verify(cmt)
+    assert decode_consensus_message(encode_consensus_message(tc)) == tc
+    # TC with a vote binding the wrong high_qc_round must fail
+    votes = list(tc.votes)
+    votes[0] = (votes[0][0], votes[0][1], 99)
+    with pytest.raises(InvalidSignatureError):
+        TC(2, tuple(votes)).verify(cmt)
+
+
+def test_genesis():
+    g = Block.genesis()
+    assert g.is_genesis()
+    assert QC.genesis().is_genesis()
+    assert g.digest() == Block.genesis().digest()
+
+
+def test_forged_genesis_qc_rejected():
+    """A round-0 QC with an attacker-chosen hash and no votes must not pass
+    as genesis: block verification has to reject it for lack of quorum."""
+    cmt = committee()
+    forged = QC(Digest.of(b"attacker junk"), 0, ())
+    assert not forged.is_genesis()
+    with pytest.raises(ConsensusError):
+        forged.verify(cmt)
+    pk, sk = keys()[1]
+    bad_block = Block.new_from_key(forged, None, pk, 1, [], sk)
+    with pytest.raises(ConsensusError):
+        bad_block.verify(cmt)

@@ -1,0 +1,46 @@
+"""Pacemaker timer tests (reference consensus/src/timer.rs), including the
+regression for the orphaned-waiter bug: a wait() armed BEFORE reset() must
+still fire at the NEW deadline (a replica that processes a block resets its
+timer while the core's select loop is already waiting on it)."""
+
+import asyncio
+import time
+
+from hotstuff_tpu.utils.actors import Timer
+
+
+def test_timer_fires(run_async):
+    async def body():
+        timer = Timer(50)
+        t0 = time.monotonic()
+        await asyncio.wait_for(timer.wait(), 5)
+        assert 0.03 <= time.monotonic() - t0 <= 2.0
+
+    run_async(body())
+
+
+def test_timer_reset_delays_firing(run_async):
+    async def body():
+        timer = Timer(100)
+        waiter = asyncio.ensure_future(timer.wait())  # armed BEFORE reset
+        await asyncio.sleep(0.05)
+        timer.reset()  # pushes deadline to +100ms from now
+        await asyncio.sleep(0.02)
+        assert not waiter.done()
+        t0 = time.monotonic()
+        await asyncio.wait_for(waiter, 5)  # must fire at the NEW deadline
+        assert time.monotonic() - t0 <= 2.0
+
+    run_async(body())
+
+
+def test_timer_repeated_resets_then_fire(run_async):
+    async def body():
+        timer = Timer(60)
+        waiter = asyncio.ensure_future(timer.wait())
+        for _ in range(5):
+            await asyncio.sleep(0.02)
+            timer.reset()
+        await asyncio.wait_for(waiter, 5)
+
+    run_async(body())
