@@ -1,0 +1,112 @@
+"""North-star benchmark: votes-verified/sec, TPU kernel vs CPU ed25519.
+
+Measures the TPU batch-verification kernel (hotstuff_tpu.ops.ed25519) on the
+attached accelerator against the host-CPU ed25519 baseline (OpenSSL via
+`cryptography` — the stand-in for the reference's ed25519_dalek
+`verify_batch`, crypto/src/lib.rs:194-220). The reference never published a
+votes/sec number (BASELINE.md: "not published — must be measured"), so
+vs_baseline is the measured TPU/CPU throughput ratio on this host
+(north-star target: >= 10x).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def bench_cpu(msgs, pks, sigs, budget_s: float = 3.0) -> float:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    keys = [Ed25519PublicKey.from_public_bytes(pk) for pk in pks]
+    n, done = len(msgs), 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        i = done % n
+        keys[i].verify(sigs[i], msgs[i])
+        done += 1
+    return done / (time.perf_counter() - t0)
+
+
+def bench_tpu(msgs, pks, sigs, iters: int, kernel: str = "w4") -> tuple[float, float]:
+    """Returns (device_rate, end_to_end_rate) in sigs/sec."""
+    import jax
+
+    from hotstuff_tpu.ops import ed25519 as ed
+
+    n = len(msgs)
+    if kernel == "pallas":
+        from hotstuff_tpu.ops.pallas_ladder import _verify_pallas_jit as fn
+    elif kernel == "bits":
+        fn = ed._verify_jit
+    else:
+        fn = ed._verify_w4_jit
+    staged = ed.prepare_batch(msgs, pks, sigs)
+    args = tuple(
+        jax.device_put(a) for a in ed.kernel_args(staged, len(msgs), kernel)
+    )
+    # compile + correctness gate
+    mask = np.asarray(fn(*args))
+    assert mask.all(), "benchmark batch must fully verify"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    device_rate = n * iters / (time.perf_counter() - t0)
+
+    # end-to-end: host staging (hash + mod-L) + transfer + kernel
+    verifier = ed.Ed25519TpuVerifier(max_bucket=max(n, 128), kernel=kernel)
+    t0 = time.perf_counter()
+    e2e_iters = max(1, iters // 4)
+    for _ in range(e2e_iters):
+        verifier.verify_batch_mask(msgs, pks, sigs)
+    e2e_rate = n * e2e_iters / (time.perf_counter() - t0)
+    return device_rate, e2e_rate
+
+
+import numpy as np  # noqa: E402  (after docstring; used in bench_tpu)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--cpu-budget", type=float, default=3.0)
+    ap.add_argument("--kernel", default="pallas", choices=["w4", "bits", "pallas"])
+    args = ap.parse_args()
+
+    from __graft_entry__ import _signed_batch
+
+    msgs, pks, sigs = _signed_batch(args.batch)
+
+    cpu_rate = bench_cpu(msgs, pks, sigs, args.cpu_budget)
+    print(f"# cpu ed25519 baseline: {cpu_rate:,.0f} sigs/s", file=sys.stderr)
+
+    device_rate, e2e_rate = bench_tpu(msgs, pks, sigs, args.iters, args.kernel)
+    print(
+        f"# tpu kernel: {device_rate:,.0f} sigs/s device, "
+        f"{e2e_rate:,.0f} sigs/s end-to-end (batch={args.batch})",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "votes_verified_per_sec",
+                "value": round(device_rate, 1),
+                "unit": "sigs/s",
+                "vs_baseline": round(device_rate / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

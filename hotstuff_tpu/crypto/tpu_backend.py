@@ -1,0 +1,71 @@
+"""TPU CryptoBackend: the north-star offload.
+
+Routes `Signature::verify_batch` / `verify_batch_alt` equivalents (the
+reference's QC::verify path consensus/src/messages.rs:197 and the mempool
+batch workload mempool/src/core.rs:135-148) to the JAX ed25519 kernel
+(hotstuff_tpu.ops.ed25519), optionally sharded across a device mesh
+(hotstuff_tpu.parallel.mesh).
+
+Small batches fall back to the host CPU: the TPU wins only past a crossover
+size (dispatch + transfer amortisation — SURVEY.md §7 "hard parts" item 3).
+The crossover is configurable and can be measured with bench.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from .backend import CpuBackend, CryptoBackend
+from .primitives import PublicKey, Signature
+
+
+class TpuBackend(CryptoBackend):
+    name = "tpu"
+
+    def __init__(
+        self,
+        crossover: int = 64,
+        max_bucket: int = 8192,
+        mesh=None,
+        sharded: bool = False,
+    ):
+        # import lazily so CPU-only processes never touch jax
+        if sharded or mesh is not None:
+            from ..parallel.mesh import ShardedEd25519Verifier
+
+            self._verifier = ShardedEd25519Verifier(
+                mesh=mesh, max_bucket=max_bucket
+            )
+        else:
+            from ..ops.ed25519 import Ed25519TpuVerifier
+
+            self._verifier = Ed25519TpuVerifier(max_bucket=max_bucket)
+        self._cpu = CpuBackend()
+        self.crossover = crossover
+        self._lock = threading.Lock()
+        self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
+
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> list[bool]:
+        n = len(messages)
+        if n == 0:
+            return []
+        if n < self.crossover:
+            with self._lock:
+                self.stats["cpu_batches"] += 1
+                self.stats["cpu_sigs"] += n
+            return self._cpu.verify_batch_mask(messages, keys, signatures)
+        with self._lock:
+            self.stats["tpu_batches"] += 1
+            self.stats["tpu_sigs"] += n
+        mask = self._verifier.verify_batch_mask(
+            list(messages),
+            [k.data for k in keys],
+            [s.data for s in signatures],
+        )
+        return mask.tolist()

@@ -58,6 +58,9 @@ async def run_client(
     burst = max(1, int(rate * BURST_INTERVAL))
     counter = 0
     rnd = os.urandom(size - 9)
+    # Monotonic per-tx tag so every client (and every burst) sends distinct
+    # transactions — payload digests must not collide (client.rs:130).
+    tx_tag = int.from_bytes(os.urandom(8), "big")
     log.info("Start sending transactions")
     start = time.monotonic()
     next_tick = start
@@ -70,7 +73,8 @@ async def run_client(
                 # NOTE: This log entry is used to compute performance.
                 log.info("Sending sample transaction %s", counter)
             else:
-                tx = b"\x01" + struct.pack(">Q", x) + rnd
+                tx_tag = (tx_tag + 1) & 0xFFFFFFFFFFFFFFFF
+                tx = b"\x01" + struct.pack(">Q", tx_tag) + rnd
             writer.write(frame(tx))
         await writer.drain()
         counter += 1

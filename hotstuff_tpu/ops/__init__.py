@@ -1,0 +1,8 @@
+"""TPU compute kernels: GF(2^255-19) limb arithmetic and batched ed25519
+verification (the reference crypto hot path, crypto/src/lib.rs:194-220,
+rebuilt as JAX SPMD kernels)."""
+
+from . import field
+from .ed25519 import Ed25519TpuVerifier, prepare_batch
+
+__all__ = ["field", "ed25519", "Ed25519TpuVerifier", "prepare_batch"]

@@ -1,0 +1,161 @@
+"""Pallas TPU kernel for the windowed double-scalar-mult ladder.
+
+The jnp ladder (ops.ed25519._verify_kernel_w4) leaves XLA to schedule ~3.5k
+field mults as separate HBM-roundtripping fusions per fori iteration. This
+kernel runs the whole 64-group ladder VMEM-resident: one grid program per
+256-lane batch block holds the accumulator point, both digit arrays and the
+16-entry tables (shared k*B and per-item k*(-A)) on-chip for all 256
+doubling steps — the only HBM traffic is the initial block load and the
+final point store.
+
+All arithmetic is ops.field on (32, BLOCK) f32 limb vectors (exact-integer
+f32, see field.py). Table lookups are unrolled masked sums over the 16
+entries (VPU fma chains — no gathers, which TPUs do poorly). Digit rows are
+selected by an iota-mask reduction instead of dynamic slicing (supported +
+cheap: 64xBLOCK fma per group).
+
+Decompression, table construction and final compression stay in plain jnp
+around the pallas_call (~15% of total work) — they run once per batch, not
+per ladder step, so VMEM residency buys little there.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import field as f
+from . import ed25519 as ed
+
+BLOCK = 256  # lanes per grid program (multiple of 128)
+
+
+def _digit_row(digits: jnp.ndarray, row) -> jnp.ndarray:
+    """digits (64, B), dynamic row index -> (B,) via iota-mask reduction."""
+    rows = lax.broadcasted_iota(jnp.int32, digits.shape, 0)
+    return jnp.sum(jnp.where(rows == row, digits, 0.0), axis=0)
+
+
+def _lookup_shared(table: jnp.ndarray, digit: jnp.ndarray) -> jnp.ndarray:
+    """table (16, 32) canonical, digit (B,) -> (32, B) masked-sum select."""
+    acc = jnp.zeros((f.NLIMB, digit.shape[0]), jnp.float32)
+    for e in range(16):
+        m = (digit == e).astype(jnp.float32)
+        acc = acc + table[e][:, None] * m[None, :]
+    return acc
+
+
+def _lookup_item(table: jnp.ndarray, digit: jnp.ndarray) -> jnp.ndarray:
+    """table (16, 32, B) per-item, digit (B,) -> (32, B)."""
+    acc = jnp.zeros(table.shape[1:], jnp.float32)
+    for e in range(16):
+        m = (digit == e).astype(jnp.float32)
+        acc = acc + table[e] * m[None, :]
+    return acc
+
+
+def _ladder_kernel(
+    sd_ref,
+    hd_ref,
+    bypx_ref,
+    bymx_ref,
+    bxy2d_ref,
+    ta_ypx_ref,
+    ta_ymx_ref,
+    ta_z_ref,
+    ta_t2d_ref,
+    x_out,
+    y_out,
+    z_out,
+    t_out,
+):
+    sd = sd_ref[:]
+    hd = hd_ref[:]
+    b_ypx, b_ymx, b_xy2d = bypx_ref[:], bymx_ref[:], bxy2d_ref[:]
+    ta_ypx, ta_ymx, ta_z, ta_t2d = (
+        ta_ypx_ref[:],
+        ta_ymx_ref[:],
+        ta_z_ref[:],
+        ta_t2d_ref[:],
+    )
+
+    def group(g, acc):
+        for _ in range(ed.WINDOW):
+            acc = ed.point_dbl(acc)
+        row = ed.NGROUPS - 1 - g
+        sdg = _digit_row(sd, row)
+        hdg = _digit_row(hd, row)
+        acc = ed.point_madd(
+            acc,
+            _lookup_shared(b_ypx, sdg),
+            _lookup_shared(b_ymx, sdg),
+            _lookup_shared(b_xy2d, sdg),
+        )
+        acc = ed.point_add_cached(
+            acc,
+            _lookup_item(ta_ypx, hdg),
+            _lookup_item(ta_ymx, hdg),
+            _lookup_item(ta_z, hdg),
+            _lookup_item(ta_t2d, hdg),
+        )
+        return acc
+
+    with f.mosaic_safe():
+        X, Y, Z, T = lax.fori_loop(
+            0, ed.NGROUPS, group, ed.point_identity(sd.shape[1])
+        )
+    x_out[:] = X
+    y_out[:] = Y
+    z_out[:] = Z
+    t_out[:] = T
+
+
+@functools.partial(jax.jit, static_argnames=())
+def ladder_pallas(s_digits, h_digits, ta_ypx, ta_ymx, ta_z, ta_t2d):
+    """(64,B) digits + per-item tables (16,32,B) -> ladder result Point."""
+    batch = s_digits.shape[1]
+    assert batch % BLOCK == 0, f"batch {batch} must be a multiple of {BLOCK}"
+    grid = (batch // BLOCK,)
+
+    digit_spec = pl.BlockSpec(
+        (ed.NGROUPS, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    shared_spec = pl.BlockSpec(
+        (16, f.NLIMB), lambda i: (0, 0), memory_space=pltpu.VMEM
+    )
+    item_spec = pl.BlockSpec(
+        (16, f.NLIMB, BLOCK), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (f.NLIMB, BLOCK), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    out_shape = jax.ShapeDtypeStruct((f.NLIMB, batch), jnp.float32)
+
+    base = [np.ascontiguousarray(t.T) for t in ed.BASE_TABLE]  # (16, 32)
+    x, y, z, t = pl.pallas_call(
+        _ladder_kernel,
+        grid=grid,
+        in_specs=[digit_spec, digit_spec] + [shared_spec] * 3 + [item_spec] * 4,
+        out_specs=[out_spec] * 4,
+        out_shape=[out_shape] * 4,
+    )(s_digits, h_digits, *base, ta_ypx, ta_ymx, ta_z, ta_t2d)
+    return x, y, z, t
+
+
+def _verify_kernel_pallas(a_y, a_sign, r_enc, s_digits, h_digits):
+    """Full verification with the ladder in pallas; same contract as
+    ed._verify_kernel_w4."""
+    x_a, xneg_a, valid = ed.decompress(a_y, a_sign)
+    ta = ed._build_neg_a_table(xneg_a, a_y)
+    result = ladder_pallas(s_digits, h_digits, *ta)
+    enc = ed.compress(result)
+    return valid & jnp.all(enc == r_enc, axis=0)
+
+
+_verify_pallas_jit = jax.jit(_verify_kernel_pallas)

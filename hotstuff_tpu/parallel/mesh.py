@@ -1,0 +1,135 @@
+"""Device-mesh parallelism for the crypto plane.
+
+The reference's only compute-dense kernel is batched signature verification
+(SURVEY.md §2.8 item 3); at committee scale (64-100 nodes, 100k tx/s input,
+BASELINE.json configs) one chip is not enough. This module shards the
+verification batch across a `jax.sharding.Mesh`:
+
+  * axis "dp" — data parallel over the vote/signature batch. Each device
+    verifies its shard; masks stay sharded; quorum counting rides ICI via
+    `psum` collectives inside `shard_map` (never DCN — consensus/mempool
+    control traffic stays host-side, SURVEY.md §5.8).
+  * axis "qc" — independent QCs / payload batches verified concurrently
+    (one QC's votes never wait on another's), the committee-facing axis.
+
+The reference's analogue is thread-level parallelism inside ed25519_dalek's
+`verify_batch` (crypto/src/lib.rs:194-207); here the same SPMD shape is
+expressed once with shard_map and compiled by XLA for any mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops import ed25519 as ed
+
+
+def default_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
+    """1-D data-parallel mesh over the available devices."""
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    return Mesh(np.array(devs), (axis,))
+
+
+def mesh_2d(n_qc: int, n_dp: int, devices=None) -> Mesh:
+    """(qc, dp) mesh: independent QC batches x vote data-parallel."""
+    devs = np.array(devices if devices is not None else jax.devices())
+    assert devs.size >= n_qc * n_dp, "not enough devices for mesh"
+    return Mesh(devs[: n_qc * n_dp].reshape(n_qc, n_dp), ("qc", "dp"))
+
+
+def _kernel_fn(kernel: str):
+    if kernel == "pallas":
+        from ..ops.pallas_ladder import _verify_kernel_pallas
+
+        return _verify_kernel_pallas
+    return ed._verify_kernel_w4 if kernel == "w4" else ed._verify_kernel
+
+
+def sharded_verify_fn(mesh: Mesh, dp_axis: str = "dp", kernel: str = "w4"):
+    """Jitted (a_y, a_sign, r_enc, s_scalars, h_scalars) -> (mask, n_valid).
+
+    Inputs are sharded over the batch (lane) dimension on `dp_axis`; each
+    device runs the full ladder on its shard; n_valid is an ICI psum.
+    """
+    batch_spec = P(None, dp_axis)
+    flat_spec = P(dp_axis)
+    base_kernel = _kernel_fn(kernel)
+
+    def local(a_y, a_sign, r_enc, s_scalars, h_scalars):
+        mask = base_kernel(a_y, a_sign, r_enc, s_scalars, h_scalars)
+        n_valid = jax.lax.psum(
+            jnp.sum(mask.astype(jnp.int32)), axis_name=dp_axis
+        )
+        return mask, n_valid
+
+    mapped = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(batch_spec, flat_spec, batch_spec, batch_spec, batch_spec),
+        out_specs=(flat_spec, P()),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+def sharded_qc_verify_fn(mesh: Mesh):
+    """Two-axis QC verification over a (qc, dp) mesh.
+
+    Inputs carry a leading QC dimension: shapes (Q, 32, B), (Q, B), ... .
+    Q shards over "qc", the vote batch over "dp". Returns per-QC valid-vote
+    counts (Q,) — the quorum-side reduction (`Aggregator::append`'s
+    weight-sum, consensus/src/aggregator.rs:78-94) as a dp-axis psum.
+    """
+
+    def local(a_y, a_sign, r_enc, s_scalars, h_scalars):
+        # vmap the single-QC kernel over this shard's QC slice
+        mask = jax.vmap(ed._verify_kernel_w4)(
+            a_y, a_sign, r_enc, s_scalars, h_scalars
+        )
+        counts = jax.lax.psum(
+            jnp.sum(mask.astype(jnp.int32), axis=1), axis_name="dp"
+        )
+        return mask, counts
+
+    spec_limb = P("qc", None, "dp")
+    spec_flat = P("qc", "dp")
+    mapped = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec_limb, spec_flat, spec_limb, spec_limb, spec_limb),
+        out_specs=(spec_flat, P("qc")),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
+    """Drop-in Ed25519TpuVerifier that shards batches over a mesh."""
+
+    def __init__(self, mesh: Mesh | None = None, **kw):
+        super().__init__(**kw)
+        self.mesh = mesh or default_mesh()
+        self._ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        # per-device shard keeps full lanes (and pallas BLOCK alignment)
+        lane = 128
+        if self.kernel == "pallas":
+            from ..ops.pallas_ladder import BLOCK
+
+            lane = BLOCK
+        self.min_bucket = max(self.min_bucket, lane * self._ndev)
+        self._fn = sharded_verify_fn(
+            self.mesh, self.mesh.axis_names[0], self.kernel
+        )
+
+    def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
+        n = len(messages)
+        staged = ed.prepare_batch(messages, keys, signatures)
+        width = self._bucket(n)
+        mask, _ = self._fn(*ed.kernel_args(staged, width, self.kernel))
+        return np.asarray(mask)[:n] & staged["s_ok"]

@@ -7,12 +7,18 @@ __graft_entry__.dryrun_multichip the same way.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The axon TPU hook force-sets JAX_PLATFORMS=axon during `import jax`, so an
+# env var is not enough: override the config AFTER import. Tests always run
+# on the virtual 8-device CPU mesh, even with a real chip attached.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import asyncio
 

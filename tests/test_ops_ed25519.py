@@ -1,0 +1,175 @@
+"""TPU ed25519 kernel correctness vs host bigint math and OpenSSL.
+
+Mirrors the reference's crypto test strategy (crypto/src/tests/crypto_tests.rs:
+49-114: valid/invalid single + batch verification) but cross-checks the JAX
+limb arithmetic against exact Python integers and the full kernel against
+signatures produced by an independent implementation (OpenSSL ed25519).
+Runs on the virtual CPU mesh (conftest.py); the same code path runs on TPU.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.ops import field as f
+from hotstuff_tpu.ops import ed25519 as ed
+
+P = f.P
+RNG = random.Random(7)
+
+
+def _batch_of_ints(values):
+    """list of ints -> (32, B) f32 limb array."""
+    cols = [f.limbs_of_int(v % P) for v in values]
+    return np.concatenate(cols, axis=1)
+
+
+def _rand_elems(n):
+    return [RNG.randrange(P) for _ in range(n)]
+
+
+class TestFieldOps:
+    def test_mul_matches_bigint(self):
+        a, b = _rand_elems(8), _rand_elems(8)
+        got = f.int_of_limbs(np.asarray(f.canonical(f.mul(_batch_of_ints(a), _batch_of_ints(b)))))
+        assert got == [(x * y) % P for x, y in zip(a, b)]
+
+    def test_mul_accepts_lazy_add_inputs(self):
+        # mul after one lazy add on each side (limbs up to ~588) stays exact.
+        a, b, c, d = (_rand_elems(4) for _ in range(4))
+        la = f.add(_batch_of_ints(a), _batch_of_ints(b))
+        lb = f.add(_batch_of_ints(c), _batch_of_ints(d))
+        got = f.int_of_limbs(np.asarray(f.canonical(f.mul(la, lb))))
+        assert got == [((x + y) * (z + w)) % P for x, y, z, w in zip(a, b, c, d)]
+
+    def test_sub_matches_bigint(self):
+        a, b = _rand_elems(8), _rand_elems(8)
+        got = f.int_of_limbs(np.asarray(f.canonical(f.sub(_batch_of_ints(a), _batch_of_ints(b)))))
+        assert got == [(x - y) % P for x, y in zip(a, b)]
+
+    def test_canonical_edge_values(self):
+        vals = [0, 1, 19, P - 1, P - 19, 2**255 - 20]  # includes p itself
+        got = f.int_of_limbs(np.asarray(f.canonical(_batch_of_ints([v + P for v in vals]))))
+        assert got == [v % P for v in vals]
+
+    def test_invert(self):
+        a = _rand_elems(4)
+        got = f.int_of_limbs(np.asarray(f.canonical(f.invert(_batch_of_ints(a)))))
+        assert got == [pow(v, P - 2, P) for v in a]
+
+    def test_pow2523(self):
+        a = _rand_elems(4)
+        got = f.int_of_limbs(np.asarray(f.canonical(f.pow2523(_batch_of_ints(a)))))
+        assert got == [pow(v, (P - 5) // 8, P) for v in a]
+
+
+class TestCurveOps:
+    """Check dbl/madd against exact affine Edwards arithmetic in Python."""
+
+    @staticmethod
+    def _affine_add(p1, p2):
+        (x1, y1), (x2, y2) = p1, p2
+        dxy = ed.D_INT * x1 * x2 * y1 * y2 % P
+        x3 = (x1 * y2 + x2 * y1) * pow(1 + dxy, P - 2, P) % P
+        y3 = (y1 * y2 + x1 * x2) * pow(1 - dxy, P - 2, P) % P
+        return x3, y3
+
+    @staticmethod
+    def _to_affine(pt):
+        X, Y, Z, _ = (np.asarray(c) for c in pt)
+        zi = pow(f.int_of_limbs(np.asarray(f.canonical(Z)))[0], P - 2, P)
+        x = f.int_of_limbs(np.asarray(f.canonical(X)))[0] * zi % P
+        y = f.int_of_limbs(np.asarray(f.canonical(Y)))[0] * zi % P
+        return x, y
+
+    @staticmethod
+    def _ext_point(x, y):
+        t = x * y % P
+        return tuple(_np_limbs(v) for v in (x, y, 1, t))
+
+    def test_dbl_and_madd(self):
+        B = (ed.BX_INT, ed.BY_INT)
+        pt = self._ext_point(*B)
+        want = B
+        # walk a few doublings and base-additions, compare to affine math
+        for _ in range(4):
+            pt = ed.point_dbl(pt)
+            want = self._affine_add(want, want)
+            assert self._to_affine(pt) == want
+            pt = ed.point_madd(pt, ed.BASE_YPX, ed.BASE_YMX, ed.BASE_XY2D)
+            want = self._affine_add(want, B)
+            assert self._to_affine(pt) == want
+
+    def test_madd_identity_cases(self):
+        # identity + B == B (unified formulas, no special-casing)
+        ident = ed.point_identity(1)
+        got = ed.point_madd(ident, ed.BASE_YPX, ed.BASE_YMX, ed.BASE_XY2D)
+        assert self._to_affine(got) == (ed.BX_INT, ed.BY_INT)
+        # doubling identity stays identity
+        assert self._to_affine(ed.point_dbl(ident)) == (0, 1)
+
+
+def _np_limbs(v: int):
+    return f.limbs_of_int(v % P)
+
+
+def _sign_many(n, msg_len=32):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        sk = Ed25519PrivateKey.from_private_bytes(bytes([i % 251 + 1] * 32))
+        m = RNG.randbytes(msg_len)
+        msgs.append(m)
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+    return msgs, pks, sigs
+
+
+class TestVerifyKernel:
+    def test_all_valid(self):
+        msgs, pks, sigs = _sign_many(5)
+        v = ed.Ed25519TpuVerifier(min_bucket=8)
+        assert v.verify_batch_mask(msgs, pks, sigs).all()
+
+    def test_mask_pinpoints_bad_items(self):
+        msgs, pks, sigs = _sign_many(6)
+        sigs[1] = sigs[1][:32] + sigs[2][32:]  # s from another signature
+        msgs[3] = b"x" * 32  # wrong message
+        sigs[4] = bytes(64)  # null signature
+        v = ed.Ed25519TpuVerifier(min_bucket=8)
+        mask = v.verify_batch_mask(msgs, pks, sigs)
+        assert mask.tolist() == [True, False, True, False, False, True]
+
+    def test_malformed_public_key_rejected(self):
+        msgs, pks, sigs = _sign_many(3)
+        # y with no valid x (not on curve): find one by scanning
+        bad = None
+        for cand in range(2, 50):
+            u = (cand * cand - 1) % P
+            vv = (ed.D_INT * cand * cand + 1) % P
+            x2 = u * pow(vv, P - 2, P) % P
+            if pow(x2, (P - 1) // 2, P) == P - 1:
+                bad = cand
+                break
+        assert bad is not None
+        pks[1] = bad.to_bytes(32, "little")
+        v = ed.Ed25519TpuVerifier(min_bucket=8)
+        assert v.verify_batch_mask(msgs, pks, sigs).tolist() == [True, False, True]
+
+    def test_non_canonical_s_rejected(self):
+        msgs, pks, sigs = _sign_many(2)
+        s_int = int.from_bytes(sigs[0][32:], "little") + ed.L_ORDER
+        sigs[0] = sigs[0][:32] + s_int.to_bytes(32, "little")
+        v = ed.Ed25519TpuVerifier(min_bucket=8)
+        # s' = s + L verifies under cofactored rules; strict mode rejects it
+        assert v.verify_batch_mask(msgs, pks, sigs).tolist() == [False, True]
+
+    def test_large_message_bodies(self):
+        # verify_batch_alt semantics: distinct, non-digest-sized messages
+        msgs, pks, sigs = _sign_many(4, msg_len=512)
+        v = ed.Ed25519TpuVerifier(min_bucket=8)
+        assert v.verify_batch_mask(msgs, pks, sigs).all()
