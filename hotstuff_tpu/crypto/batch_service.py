@@ -1,0 +1,113 @@
+"""BatchVerificationService: deadline-flushed signature-verification actor.
+
+The north-star constraint (BASELINE.json): TPU batch verification must not
+regress consensus latency — QC formation blocks round advancement, so
+per-vote verification cannot wait for a large batch to fill. This actor
+generalises the reference's SignatureService request/oneshot seam
+(crypto/src/lib.rs:226-252) to verification: callers await single
+(message, key, signature) checks; the actor accumulates concurrent requests
+and flushes to the active CryptoBackend when either
+
+  * the pending batch reaches `max_batch` (size flush, TPU-efficient), or
+  * the oldest request is `max_delay` seconds old (deadline flush, keeps
+    p99 latency bounded at low rates — SURVEY.md §7 "hard parts" item 1).
+
+The backend call runs in a worker thread so the TPU dispatch never blocks
+the event loop (the mempool/consensus cores keep processing while a batch
+is in flight — the same pipelining the reference gets from tokio).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Sequence
+
+from .backend import CryptoBackend, get_backend
+from .primitives import PublicKey, Signature
+
+
+class BatchVerificationService:
+    def __init__(
+        self,
+        backend: CryptoBackend | None = None,
+        max_batch: int = 4096,
+        max_delay: float = 0.002,
+    ) -> None:
+        self._backend = backend
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self.stats = {"flushes": 0, "size_flushes": 0, "verified": 0}
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(
+                self._run(), name="batch-verification-service"
+            )
+
+    @property
+    def backend(self) -> CryptoBackend:
+        return self._backend or get_backend()
+
+    async def verify(
+        self, message: bytes, key: PublicKey, signature: Signature
+    ) -> bool:
+        """Await a single verification (batched under the hood)."""
+        self._ensure_task()
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((message, key, signature, fut))
+        return await fut
+
+    async def verify_many(
+        self,
+        messages: Sequence[bytes],
+        pairs: Sequence[tuple[PublicKey, Signature]],
+    ) -> list[bool]:
+        """Submit a correlated group (e.g. one QC's votes); resolves when
+        every member's result is in (they may span multiple flushes)."""
+        self._ensure_task()
+        loop = asyncio.get_running_loop()
+        futs = [loop.create_future() for _ in messages]
+        for m, (pk, sig), fut in zip(messages, pairs, futs):
+            await self._queue.put((m, pk, sig, fut))
+        return list(await asyncio.gather(*futs))
+
+    async def _run(self) -> None:
+        while True:
+            first = await self._queue.get()
+            batch = [first]
+            deadline = asyncio.get_running_loop().time() + self.max_delay
+            while len(batch) < self.max_batch:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    batch.append(
+                        await asyncio.wait_for(self._queue.get(), timeout)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            # opportunistic drain of anything already enqueued
+            while len(batch) < self.max_batch and not self._queue.empty():
+                batch.append(self._queue.get_nowait())
+
+            msgs = [m for m, _, _, _ in batch]
+            keys = [k for _, k, _, _ in batch]
+            sigs = [s for _, _, s, _ in batch]
+            backend = self.backend
+            try:
+                mask = await asyncio.to_thread(
+                    backend.verify_batch_mask, msgs, keys, sigs
+                )
+            except Exception as exc:  # backend failure must not hang callers
+                for _, _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                continue
+            self.stats["flushes"] += 1
+            self.stats["size_flushes"] += len(batch) >= self.max_batch
+            self.stats["verified"] += len(batch)
+            for (_, _, _, fut), ok in zip(batch, mask):
+                if not fut.cancelled():
+                    fut.set_result(bool(ok))

@@ -1,0 +1,131 @@
+"""ctypes bridge to the C++ batch-staging plane (native/staging.cpp).
+
+Builds the shared library on first use (g++ -O3, cached next to the
+source), falling back to the pure-Python staging in ops/ed25519 when a
+toolchain is unavailable. This is the native data-plane component the
+reference gets from Rust (SURVEY.md §2: each crate maps to a native
+equivalent); the control flow stays in Python, the per-byte work in C++.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
+_SO_PATH = _NATIVE_DIR / "libhotstuff_native.so"
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> pathlib.Path | None:
+    src = _NATIVE_DIR / "staging.cpp"
+    hdr = _NATIVE_DIR / "constants.h"
+    if not src.exists():
+        return None
+    try:
+        if not hdr.exists():
+            subprocess.run(
+                ["python", str(_NATIVE_DIR / "gen_constants.py")],
+                check=True,
+                capture_output=True,
+            )
+        if (
+            not _SO_PATH.exists()
+            or _SO_PATH.stat().st_mtime < src.stat().st_mtime
+        ):
+            subprocess.run(
+                [
+                    "g++",
+                    "-O3",
+                    "-shared",
+                    "-fPIC",
+                    "-std=c++17",
+                    str(src),
+                    "-o",
+                    str(_SO_PATH),
+                ],
+                check=True,
+                capture_output=True,
+            )
+        return _SO_PATH
+    except (subprocess.CalledProcessError, OSError) as e:
+        log.warning("native staging build failed, using Python path: %s", e)
+        return None
+
+
+def get_lib():
+    """The loaded native library, or None (build failure / no toolchain)."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        so = _build()
+        if so is None:
+            return None
+        lib = ctypes.CDLL(str(so))
+        lib.hs_stage_batch.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def stage_batch(messages, keys, signatures) -> dict | None:
+    """Native equivalent of ops.ed25519.prepare_batch (same dict contract,
+    minus the bit arrays used only by the legacy bit-ladder kernel)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(messages)
+    msg_blob = b"".join(messages)
+    offsets = np.zeros(n + 1, np.int64)
+    np.cumsum([len(m) for m in messages], out=offsets[1:])
+    msgs = np.frombuffer(msg_blob, np.uint8)
+    keys_arr = np.frombuffer(b"".join(keys), np.uint8)
+    sigs_arr = np.frombuffer(b"".join(signatures), np.uint8)
+
+    a_y = np.empty((32, n), np.float32)
+    a_sign = np.empty(n, np.float32)
+    r_enc = np.empty((32, n), np.float32)
+    s_digits = np.empty((64, n), np.float32)
+    h_digits = np.empty((64, n), np.float32)
+    s_ok = np.empty(n, np.uint8)
+
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    f32p = ctypes.POINTER(ctypes.c_float)
+
+    def p(arr, ty):
+        return arr.ctypes.data_as(ty)
+
+    rc = lib.hs_stage_batch(
+        p(msgs, u8p),
+        p(offsets, i64p),
+        p(keys_arr, u8p),
+        p(sigs_arr, u8p),
+        ctypes.c_int64(n),
+        p(a_y, f32p),
+        p(a_sign, f32p),
+        p(r_enc, f32p),
+        p(s_digits, f32p),
+        p(h_digits, f32p),
+        p(s_ok, u8p),
+    )
+    if rc != 0:
+        return None
+    return dict(
+        a_y=a_y,
+        a_sign=a_sign,
+        r_enc=r_enc,
+        s_digits=s_digits,
+        h_digits=h_digits,
+        s_ok=s_ok.astype(bool),
+    )

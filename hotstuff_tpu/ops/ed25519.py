@@ -325,8 +325,22 @@ def prepare_batch(
     messages: Sequence[bytes],
     keys: Sequence[bytes],
     signatures: Sequence[bytes],
+    want_bits: bool = False,
+    allow_native: bool = True,
 ) -> dict:
-    """numpy staging of a batch. keys: 32-byte pks; signatures: 64 bytes."""
+    """numpy staging of a batch. keys: 32-byte pks; signatures: 64 bytes.
+
+    Dispatches to the C++ staging plane (crypto/native_staging) when built —
+    the Python path below is the reference implementation and fallback.
+    `want_bits` additionally materialises the (253, B) bit arrays used only
+    by the legacy bit-ladder kernel.
+    """
+    if allow_native and not want_bits:
+        from ..crypto import native_staging
+
+        staged = native_staging.stage_batch(messages, keys, signatures)
+        if staged is not None:
+            return staged
     n = len(messages)
     a = np.frombuffer(b"".join(keys), np.uint8).reshape(n, 32)
     sig = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
@@ -347,18 +361,20 @@ def prepare_batch(
         h = int.from_bytes(hd, "little") % L_ORDER
         h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
 
-    s_bits = np.unpackbits(s, axis=1, bitorder="little").T[:SCALAR_BITS]
-    h_bits = np.unpackbits(h_bytes, axis=1, bitorder="little").T[:SCALAR_BITS]
-    return dict(
+    staged = dict(
         a_y=a_y,
         a_sign=a_sign,
         r_enc=r_enc,
-        s_bits=s_bits.astype(np.float32),
-        h_bits=h_bits.astype(np.float32),
         s_digits=_nibbles(s),
         h_digits=_nibbles(h_bytes),
         s_ok=s_ok,
     )
+    if want_bits:  # legacy bit-ladder kernel only
+        sb = np.unpackbits(s, axis=1, bitorder="little").T[:SCALAR_BITS]
+        hb = np.unpackbits(h_bytes, axis=1, bitorder="little").T[:SCALAR_BITS]
+        staged["s_bits"] = sb.astype(np.float32)
+        staged["h_bits"] = hb.astype(np.float32)
+    return staged
 
 
 def _nibbles(b: np.ndarray) -> np.ndarray:
@@ -426,7 +442,9 @@ class Ed25519TpuVerifier:
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)
-        staged = prepare_batch(messages, keys, signatures)
+        staged = prepare_batch(
+            messages, keys, signatures, want_bits=self.kernel == "bits"
+        )
         width = self._bucket(n)
         mask = _verify_jit_args(staged, width, self.kernel)
         return np.asarray(mask)[:n] & staged["s_ok"]

@@ -178,6 +178,25 @@ def _conv_rows(a, b, batch):
     return jnp.concatenate(rows, axis=0)
 
 
+def _conv_shift(a, b, batch):
+    """Scatter-free conv on full (66, B) tiles: tree-sum of zero-padded
+    shifted products. Same FLOPs as _conv_rows but each op covers whole
+    (sublane, lane) tiles instead of single (B,) rows — better VPU issue
+    efficiency inside Mosaic kernels."""
+    parts = []
+    for i in range(NLIMB):
+        prod = jnp.broadcast_to(a[i] * b, (NLIMB,) + batch)
+        parts.append(
+            jnp.pad(prod, ((i, NLIMB + 2 - i), (0, 0)))
+        )
+    while len(parts) > 1:  # balanced tree keeps live values narrow
+        parts = [
+            parts[j] + parts[j + 1] if j + 1 < len(parts) else parts[j]
+            for j in range(0, len(parts), 2)
+        ]
+    return parts[0]
+
+
 def _reduce_512(c: jnp.ndarray) -> jnp.ndarray:
     """(66, B) raw product -> normalized 32-limb element."""
     # carry the product down to <=256/limb (no wrap: rows 63..65 give the
@@ -196,6 +215,9 @@ def _reduce_512(c: jnp.ndarray) -> jnp.ndarray:
     return _carry32(folded + extra)
 
 
+MOSAIC_CONV = "shift"  # "rows" | "shift" — conv flavour inside Pallas
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiplication; normalized output (limbs <= ~295).
 
@@ -208,7 +230,10 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     exceed 2^512, so the convolution gets 66 rows (see _reduce_512).
     """
     batch = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
-    conv = _conv_rows if _MOSAIC_SAFE else _conv_scatter
+    if _MOSAIC_SAFE:
+        conv = _conv_shift if MOSAIC_CONV == "shift" else _conv_rows
+    else:
+        conv = _conv_scatter
     return _reduce_512(conv(a, b, batch))
 
 
@@ -218,19 +243,26 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
     batch = a.shape[1:]
     a2 = a + a
     if _MOSAIC_SAFE:
-        rows = []
-        for k in range(2 * NLIMB - 1):
-            lo, hi = max(0, k - NLIMB + 1), min(k, NLIMB - 1)
-            term = None
-            for i in range(lo, hi + 1):
-                j = k - i
-                if i > j:
-                    break
-                t = a[i] * a[i] if i == j else a2[i] * a[j]
-                term = t if term is None else term + t
-            rows.append(jnp.broadcast_to(term, batch)[None])
-        rows.append(jnp.zeros((3,) + batch, jnp.float32))
-        return _reduce_512(jnp.concatenate(rows, axis=0))
+        # shift form: block i contributes [a_i^2, 2*a_i*a_{i+1..}] at
+        # offset 2i; zero-padded full-tile adds (see _conv_shift)
+        parts = []
+        for i in range(NLIMB):
+            sq = jnp.broadcast_to(a[i] * a[i], batch)[None]
+            if i + 1 < NLIMB:
+                cross = jnp.broadcast_to(
+                    a2[i] * a[i + 1 :], (NLIMB - 1 - i,) + batch
+                )
+                block = jnp.concatenate([sq, cross], axis=0)
+            else:
+                block = sq
+            top, rows = 2 * i, NLIMB - i
+            parts.append(jnp.pad(block, ((top, 2 * NLIMB + 2 - top - rows), (0, 0))))
+        while len(parts) > 1:
+            parts = [
+                parts[j] + parts[j + 1] if j + 1 < len(parts) else parts[j]
+                for j in range(0, len(parts), 2)
+            ]
+        return _reduce_512(parts[0])
     c = jnp.zeros((2 * NLIMB + 2,) + batch, a.dtype)
     for i in range(NLIMB):
         c = c.at[2 * i].add(a[i] * a[i])

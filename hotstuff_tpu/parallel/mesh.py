@@ -87,11 +87,12 @@ def sharded_qc_verify_fn(mesh: Mesh):
     weight-sum, consensus/src/aggregator.rs:78-94) as a dp-axis psum.
     """
 
-    def local(a_y, a_sign, r_enc, s_scalars, h_scalars):
+    def local(a_y, a_sign, r_enc, s_scalars, h_scalars, s_ok):
         # vmap the single-QC kernel over this shard's QC slice
         mask = jax.vmap(ed._verify_kernel_w4)(
             a_y, a_sign, r_enc, s_scalars, h_scalars
         )
+        mask = mask & s_ok  # host-checked s < L canonicality (malleability)
         counts = jax.lax.psum(
             jnp.sum(mask.astype(jnp.int32), axis=1), axis_name="dp"
         )
@@ -102,7 +103,14 @@ def sharded_qc_verify_fn(mesh: Mesh):
     mapped = shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec_limb, spec_flat, spec_limb, spec_limb, spec_limb),
+        in_specs=(
+            spec_limb,
+            spec_flat,
+            spec_limb,
+            spec_limb,
+            spec_limb,
+            spec_flat,
+        ),
         out_specs=(spec_flat, P("qc")),
         check_rep=False,
     )
@@ -129,7 +137,9 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)
-        staged = ed.prepare_batch(messages, keys, signatures)
+        staged = ed.prepare_batch(
+            messages, keys, signatures, want_bits=self.kernel == "bits"
+        )
         width = self._bucket(n)
         mask, _ = self._fn(*ed.kernel_args(staged, width, self.kernel))
         return np.asarray(mask)[:n] & staged["s_ok"]

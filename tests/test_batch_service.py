@@ -1,0 +1,79 @@
+"""BatchVerificationService: deadline/size flush semantics and correctness."""
+
+import asyncio
+import random
+
+import pytest
+
+from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+from hotstuff_tpu.crypto.backend import CpuBackend
+from hotstuff_tpu.crypto.batch_service import BatchVerificationService
+
+
+@pytest.fixture
+def keys():
+    rng = random.Random(0)
+    return [generate_keypair(rng) for _ in range(4)]
+
+
+def test_single_requests_batched(keys, run_async):
+    async def body():
+        svc = BatchVerificationService(CpuBackend(), max_delay=0.01)
+        digest = Digest.of(b"vote")
+        results = await asyncio.gather(
+            *[
+                svc.verify(digest.data, pk, Signature.new(digest, sk))
+                for pk, sk in keys
+            ]
+        )
+        assert results == [True] * 4
+        # all four individual requests coalesced into one backend flush
+        assert svc.stats["flushes"] == 1 and svc.stats["verified"] == 4
+
+    run_async(body())
+
+
+def test_invalid_items_isolated(keys, run_async):
+    async def body():
+        svc = BatchVerificationService(CpuBackend(), max_delay=0.01)
+        digest = Digest.of(b"vote")
+        pk0, sk0 = keys[0]
+        pk1, sk1 = keys[1]
+        good = svc.verify(digest.data, pk0, Signature.new(digest, sk0))
+        bad = svc.verify(digest.data, pk1, Signature.new(digest, sk0))
+        assert await asyncio.gather(good, bad) == [True, False]
+
+    run_async(body())
+
+
+def test_size_flush_before_deadline(keys, run_async):
+    async def body():
+        svc = BatchVerificationService(
+            CpuBackend(), max_batch=8, max_delay=10.0
+        )
+        digest = Digest.of(b"vote")
+        pk, sk = keys[0]
+        sig = Signature.new(digest, sk)
+        t0 = asyncio.get_running_loop().time()
+        results = await asyncio.gather(
+            *[svc.verify(digest.data, pk, sig) for _ in range(8)]
+        )
+        took = asyncio.get_running_loop().time() - t0
+        assert all(results)
+        assert took < 5.0, "size flush must not wait for the deadline"
+        assert svc.stats["size_flushes"] >= 1
+
+    run_async(body())
+
+
+def test_verify_many_spanning_flushes(keys, run_async):
+    async def body():
+        svc = BatchVerificationService(
+            CpuBackend(), max_batch=3, max_delay=0.005
+        )
+        digest = Digest.of(b"qc")
+        pairs = [(pk, Signature.new(digest, sk)) for pk, sk in keys]
+        mask = await svc.verify_many([digest.data] * 4, pairs)
+        assert mask == [True] * 4
+
+    run_async(body())

@@ -1,0 +1,79 @@
+"""C++ staging plane vs the pure-Python reference staging.
+
+The native path (native/staging.cpp via crypto/native_staging) must produce
+bit-identical arrays to ops.ed25519.prepare_batch's Python implementation —
+SHA-512, mod-L reduction, limb extraction, digit packing, s-canonicality."""
+
+import ctypes
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.crypto import native_staging as ns
+from hotstuff_tpu.ops import ed25519 as ed
+
+pytestmark = pytest.mark.skipif(
+    ns.get_lib() is None, reason="native toolchain unavailable"
+)
+
+RNG = random.Random(11)
+
+
+def _batch(n, msg_len=64):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    msgs, pks, sigs = [], [], []
+    for i in range(n):
+        sk = Ed25519PrivateKey.from_private_bytes(RNG.randbytes(32))
+        m = RNG.randbytes(RNG.randrange(1, msg_len))
+        msgs.append(m)
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+    return msgs, pks, sigs
+
+
+def test_sha512_matches_hashlib():
+    lib = ns.get_lib()
+    for ln in [0, 1, 63, 64, 111, 112, 127, 128, 129, 500]:
+        data = RNG.randbytes(ln)
+        out = (ctypes.c_uint8 * 64)()
+        lib.hs_sha512(data, ctypes.c_int64(ln), out)
+        assert bytes(out) == hashlib.sha512(data).digest(), ln
+
+
+def test_mod_l_edge_values():
+    lib = ns.get_lib()
+    L = ed.L_ORDER
+    cases = [0, 1, L - 1, L, L + 1, 2**252, 2**512 - 1, (L << 134) + 5]
+    cases += [RNG.randrange(2**512) for _ in range(500)]
+    for v in cases:
+        red = (ctypes.c_uint8 * 32)()
+        lib.hs_reduce_mod_l(v.to_bytes(64, "little"), red)
+        assert int.from_bytes(bytes(red), "little") == v % L
+
+
+def test_stage_batch_matches_python():
+    msgs, pks, sigs = _batch(40)
+    # include adversarial items: non-canonical s, corrupted bytes
+    sigs[3] = sigs[3][:32] + (
+        int.from_bytes(sigs[3][32:], "little") + ed.L_ORDER
+    ).to_bytes(32, "little")
+    sigs[5] = bytes(64)
+    pks[7] = bytes(31) + b"\xff"
+    native = ns.stage_batch(msgs, pks, sigs)
+    python = ed.prepare_batch(msgs, pks, sigs, allow_native=False)
+    for key in ("a_y", "a_sign", "r_enc", "s_digits", "h_digits"):
+        np.testing.assert_array_equal(native[key], python[key], err_msg=key)
+    np.testing.assert_array_equal(native["s_ok"], python["s_ok"])
+
+
+def test_prepare_batch_uses_native_by_default():
+    msgs, pks, sigs = _batch(4)
+    staged = ed.prepare_batch(msgs, pks, sigs)
+    assert "s_bits" not in staged  # native dict omits the legacy bit arrays
+    python = ed.prepare_batch(msgs, pks, sigs, allow_native=False)
+    np.testing.assert_array_equal(staged["h_digits"], python["h_digits"])
