@@ -27,9 +27,10 @@ _tried = False
 
 
 def _build() -> pathlib.Path | None:
-    src = _NATIVE_DIR / "staging.cpp"
+    srcs = [_NATIVE_DIR / "staging.cpp", _NATIVE_DIR / "store.cpp"]
     hdr = _NATIVE_DIR / "constants.h"
-    if not src.exists():
+    srcs = [s for s in srcs if s.exists()]
+    if not srcs:
         return None
     try:
         if not hdr.exists():
@@ -38,27 +39,18 @@ def _build() -> pathlib.Path | None:
                 check=True,
                 capture_output=True,
             )
-        if (
-            not _SO_PATH.exists()
-            or _SO_PATH.stat().st_mtime < src.stat().st_mtime
-        ):
+        newest_src = max(s.stat().st_mtime for s in srcs)
+        if not _SO_PATH.exists() or _SO_PATH.stat().st_mtime < newest_src:
             subprocess.run(
-                [
-                    "g++",
-                    "-O3",
-                    "-shared",
-                    "-fPIC",
-                    "-std=c++17",
-                    str(src),
-                    "-o",
-                    str(_SO_PATH),
-                ],
+                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+                + [str(s) for s in srcs]
+                + ["-o", str(_SO_PATH)],
                 check=True,
                 capture_output=True,
             )
         return _SO_PATH
     except (subprocess.CalledProcessError, OSError) as e:
-        log.warning("native staging build failed, using Python path: %s", e)
+        log.warning("native build failed, using Python path: %s", e)
         return None
 
 

@@ -1,0 +1,111 @@
+// Native log-structured KV engine for the Store actor.
+//
+// The reference's store crate wraps rocksdb behind a single-writer actor
+// (store/src/lib.rs:15-92). Here the data plane — hash index, append-only
+// length-prefixed log, crash-safe replay that ignores a torn tail — is
+// C++; the Python actor (hotstuff_tpu/store/store.py) keeps the channel
+// protocol and notify_read obligations and calls in via ctypes.
+//
+// Log record: <u32 klen><u32 vlen><key><value>, little-endian.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::unordered_map<std::string, std::string> index;
+  FILE *log = nullptr;
+  bool fsync_writes = false;
+};
+
+void replay(Store *s, const char *path) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return;
+  std::vector<uint8_t> hdr(8);
+  std::string key, val;
+  for (;;) {
+    if (fread(hdr.data(), 1, 8, f) != 8) break;
+    uint32_t klen, vlen;
+    memcpy(&klen, hdr.data(), 4);
+    memcpy(&vlen, hdr.data() + 4, 4);
+    // guard against a corrupt header at the torn tail
+    if (klen > (1u << 20) || vlen > (1u << 28)) break;
+    key.resize(klen);
+    val.resize(vlen);
+    if (klen && fread(&key[0], 1, klen, f) != klen) break;
+    if (vlen && fread(&val[0], 1, vlen, f) != vlen) break;
+    s->index[key] = val;
+  }
+  fclose(f);
+}
+
+}  // namespace
+
+extern "C" {
+
+void *hs_store_open(const char *path, int fsync_writes) {
+  auto *s = new Store;
+  s->fsync_writes = fsync_writes != 0;
+  if (path && path[0]) {
+    replay(s, path);
+    s->log = fopen(path, "ab");
+    if (!s->log) {
+      delete s;
+      return nullptr;
+    }
+  }
+  return s;
+}
+
+int hs_store_write(void *sp, const uint8_t *k, int64_t klen, const uint8_t *v,
+                   int64_t vlen) {
+  auto *s = static_cast<Store *>(sp);
+  s->index[std::string((const char *)k, klen)] =
+      std::string((const char *)v, vlen);
+  if (s->log) {
+    uint32_t kl = (uint32_t)klen, vl = (uint32_t)vlen;
+    if (fwrite(&kl, 4, 1, s->log) != 1) return -1;
+    if (fwrite(&vl, 4, 1, s->log) != 1) return -1;
+    if (klen && fwrite(k, 1, klen, s->log) != (size_t)klen) return -1;
+    if (vlen && fwrite(v, 1, vlen, s->log) != (size_t)vlen) return -1;
+    if (fflush(s->log) != 0) return -1;
+  }
+  return 0;
+}
+
+// Returns value length and malloc'd buffer in *out (caller frees via
+// hs_free), or -1 if absent.
+int64_t hs_store_read(void *sp, const uint8_t *k, int64_t klen,
+                      uint8_t **out) {
+  auto *s = static_cast<Store *>(sp);
+  auto it = s->index.find(std::string((const char *)k, klen));
+  if (it == s->index.end()) return -1;
+  *out = (uint8_t *)malloc(it->second.size());
+  memcpy(*out, it->second.data(), it->second.size());
+  return (int64_t)it->second.size();
+}
+
+int hs_store_contains(void *sp, const uint8_t *k, int64_t klen) {
+  auto *s = static_cast<Store *>(sp);
+  return s->index.count(std::string((const char *)k, klen)) ? 1 : 0;
+}
+
+int64_t hs_store_len(void *sp) {
+  return (int64_t)static_cast<Store *>(sp)->index.size();
+}
+
+void hs_store_close(void *sp) {
+  auto *s = static_cast<Store *>(sp);
+  if (s->log) fclose(s->log);
+  delete s;
+}
+
+void hs_free(void *p) { free(p); }
+
+}  // extern "C"
