@@ -38,7 +38,9 @@ def bench_tpu(msgs, pks, sigs, iters: int, kernel: str = "w4") -> tuple[float, f
     """Returns (device_rate, end_to_end_rate) in sigs/sec."""
     import jax
 
-    from hotstuff_tpu.ops import ed25519 as ed
+    from hotstuff_tpu.ops import ed25519 as ed, enable_persistent_cache
+
+    enable_persistent_cache()
 
     n = len(msgs)
     if kernel == "pallas":

@@ -61,4 +61,54 @@ def aggregate_results(directory: str = "results") -> dict:
             )
     with open(join(directory, "aggregated.txt"), "w") as f:
         f.write("\n".join(lines) + "\n")
+    _write_family_files(out, directory)
     return out
+
+
+def _write_family_files(
+    out: dict, directory: str, max_latency_ms=(2_000, 5_000)
+) -> None:
+    """The reference's per-plot-family agg files (aggregate.py:75-174):
+    latency (L-graph points), robustness (tput vs input rate), and best-tps
+    under a max-latency SLO per committee size."""
+    lat_lines, rob_lines = [], []
+    for (nodes, faults, tx_size, rate), agg in out.items():
+        tag = f"nodes={nodes:.0f} faults={faults:.0f} tx={tx_size:.0f}"
+        lat_lines.append(
+            f"{tag} rate={rate:.0f} tps={agg['e2e_tps']['mean']:.0f} "
+            f"latency_ms={agg['e2e_latency']['mean']:.0f} "
+            f"±{agg['e2e_latency']['stdev']:.0f}"
+        )
+        rob_lines.append(
+            f"{tag} rate={rate:.0f} tps={agg['e2e_tps']['mean']:.0f} "
+            f"±{agg['e2e_tps']['stdev']:.0f}"
+        )
+    with open(join(directory, "agg-latency.txt"), "w") as f:
+        f.write("\n".join(lat_lines) + "\n")
+    with open(join(directory, "agg-robustness.txt"), "w") as f:
+        f.write("\n".join(rob_lines) + "\n")
+
+    tps_lines = []
+    for slo in max_latency_ms:
+        best = best_tps_under_slo(out, slo)
+        for nodes in sorted(best):
+            tps_lines.append(
+                f"max_latency_ms={slo} nodes={nodes:.0f} best_tps={best[nodes][0]:.0f}"
+            )
+    with open(join(directory, "agg-tps.txt"), "w") as f:
+        f.write("\n".join(tps_lines) + "\n")
+
+
+def best_tps_under_slo(out: dict, slo_ms: float) -> dict[float, tuple]:
+    """Per committee size, the best (tps_mean, tps_stdev) among fault-free
+    setups whose mean e2e latency stays under `slo_ms` — the selection rule
+    behind both agg-tps.txt and the tps-vs-committee plot."""
+    best: dict[float, tuple] = {}
+    for (nodes, faults, tx_size, rate), agg in out.items():
+        if faults:
+            continue
+        if agg["e2e_latency"]["mean"] <= slo_ms and (
+            nodes not in best or agg["e2e_tps"]["mean"] > best[nodes][0]
+        ):
+            best[nodes] = (agg["e2e_tps"]["mean"], agg["e2e_tps"]["stdev"])
+    return best

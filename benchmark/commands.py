@@ -29,12 +29,23 @@ class CommandMaker:
         return f"{sys.executable} -m hotstuff_tpu.node.main keys --filename {filename}"
 
     @staticmethod
-    def run_node(keys: str, committee: str, store: str, parameters: str, crypto: str = "cpu", debug: bool = False) -> str:
+    def run_node(keys: str, committee: str, store: str, parameters: str, crypto: str = "cpu", crypto_addr: str | None = None, debug: bool = False) -> str:
         v = "-vvv" if debug else "-vv"
+        addr = f" --crypto-addr {crypto_addr}" if crypto_addr else ""
         return (
             f"{sys.executable} -m hotstuff_tpu.node.main {v} run "
             f"--keys {keys} --committee {committee} --store {store} "
-            f"--parameters {parameters} --crypto {crypto}"
+            f"--parameters {parameters} --crypto {crypto}{addr}"
+        )
+
+    @staticmethod
+    def run_sidecar(port: int, backend: str = "tpu", debug: bool = False) -> str:
+        """The shared crypto sidecar: one process owns the TPU; all local
+        nodes ship their large verification batches to it."""
+        v = "-vvv" if debug else "-vv"
+        return (
+            f"{sys.executable} -m hotstuff_tpu.crypto.remote {v} "
+            f"--port {port} --backend {backend}"
         )
 
     @staticmethod
@@ -48,7 +59,8 @@ class CommandMaker:
 
     @staticmethod
     def kill() -> str:
-        return "pkill -f hotstuff_tpu.node || true"
+        # covers node, client, AND the crypto sidecar (hotstuff_tpu.crypto.remote)
+        return "pkill -f 'hotstuff_tpu.node' ; pkill -f 'hotstuff_tpu.crypto.remote' || true"
 
     @staticmethod
     def logs_path(directory: str, kind: str, i: int) -> str:

@@ -80,6 +80,35 @@ class LocalBench:
             committee.write(".committee.json")
             self.node_params.write(".parameters.json")
 
+            # TPU crypto: boot ONE sidecar process owning the chip; nodes
+            # connect as remote clients (the TPU is process-exclusive).
+            node_crypto, crypto_addr = self.crypto, None
+            if self.crypto == "tpu":
+                sidecar_port = self.BASE_PORT - 100
+                crypto_addr = f"127.0.0.1:{sidecar_port}"
+                self._background_run(
+                    CommandMaker.run_sidecar(sidecar_port, "tpu", debug=debug),
+                    join("logs", "sidecar.log"),
+                )
+                sidecar_proc = self._procs[-1]
+                deadline = time.monotonic() + 180  # first jit compile is slow
+                while time.monotonic() < deadline:
+                    if sidecar_proc.poll() is not None:
+                        raise BenchError(
+                            "crypto sidecar exited at startup "
+                            f"(rc={sidecar_proc.returncode}); see logs/sidecar.log"
+                        )
+                    try:
+                        with open(join("logs", "sidecar.log")) as f:
+                            if "successfully booted" in f.read():
+                                break
+                    except OSError:
+                        pass
+                    time.sleep(0.5)
+                else:
+                    raise BenchError("crypto sidecar never booted")
+                node_crypto = "remote"
+
             # Boot nodes (skipping `faults` of them -- fault injection by
             # simply not booting, local.py:75-76).
             for i in range(boot):
@@ -88,7 +117,8 @@ class LocalBench:
                     ".committee.json",
                     f".db-{i}/log",
                     ".parameters.json",
-                    crypto=self.crypto,
+                    crypto=node_crypto,
+                    crypto_addr=crypto_addr,
                     debug=debug,
                 )
                 self._background_run(cmd, CommandMaker.logs_path("logs", "node", i))

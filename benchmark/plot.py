@@ -1,17 +1,141 @@
-"""Result plotting (reference benchmark/benchmark/plot.py:56-164):
-latency-vs-throughput and throughput-vs-committee-size errorbar plots with
-dual tx/s / MB/s axes.
+"""Result plotting (reference benchmark/benchmark/plot.py:56-164): the three
+reference plot families, each as errorbar plots with dual tx/s / MB/s axes:
+
+  * latency-vs-throughput  — the L-graph, one line per committee size
+  * tps-vs-committee-size  — best throughput under a max-latency SLO
+  * robustness             — achieved throughput vs input rate (saturation
+                             collapse), one line per committee size
 """
 
 from __future__ import annotations
 
-from glob import glob
 from os.path import join
 
 from .aggregate import aggregate_results
 
 
-def plot_results(directory: str = "results") -> None:
+def _uniform_tx_size(agg) -> float:
+    """The single tx size across all setups, or 0 when sizes are mixed (an
+    MB/s axis computed from one of several sizes would mislabel the rest)."""
+    sizes = {ts for (_, _, ts, _) in agg}
+    return sizes.pop() if len(sizes) == 1 else 0.0
+
+
+def _mbps_axis(ax, tx_size: float):
+    """Secondary x axis in MB/s (the reference's dual tx/s-MB/s axes)."""
+    if not tx_size:
+        return
+    sec = ax.secondary_xaxis(
+        "top",
+        functions=(
+            lambda x: x * tx_size / 1e6,
+            lambda x: x * 1e6 / tx_size if tx_size else x,
+        ),
+    )
+    sec.set_xlabel("Throughput (MB/s)")
+
+
+def _plot_latency(agg, directory, plt) -> str:
+    by_nodes: dict[tuple, list] = {}
+    tx_size = _uniform_tx_size(agg)
+    for (nodes, faults, ts, rate), m in agg.items():
+        by_nodes.setdefault((nodes, faults), []).append(
+            (
+                m["e2e_tps"]["mean"],
+                m["e2e_latency"]["mean"],
+                m["e2e_latency"]["stdev"],
+            )
+        )
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for (nodes, faults), pts in sorted(by_nodes.items()):
+        pts.sort()
+        label = f"{int(nodes)} nodes" + (f" ({int(faults)} faulty)" if faults else "")
+        ax.errorbar(
+            [p[0] for p in pts],
+            [p[1] / 1000.0 for p in pts],
+            yerr=[p[2] / 1000.0 for p in pts],
+            marker="o",
+            capsize=3,
+            label=label,
+        )
+    ax.set_xlabel("Throughput (tx/s)")
+    ax.set_ylabel("Latency (s)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    _mbps_axis(ax, tx_size)
+    fig.tight_layout()
+    out = join(directory, "latency-vs-throughput.pdf")
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def _plot_tps_vs_committee(agg, directory, plt, max_latency_ms) -> str:
+    """Best throughput per committee size whose e2e latency stays under each
+    SLO (reference plot.py tps-vs-committee under max-latency)."""
+    from .aggregate import best_tps_under_slo
+
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for slo in max_latency_ms:
+        best = best_tps_under_slo(agg, slo)
+        if not best:
+            continue
+        xs = sorted(best)
+        ax.errorbar(
+            xs,
+            [best[x][0] for x in xs],
+            yerr=[best[x][1] for x in xs],
+            marker="s",
+            capsize=3,
+            label=f"latency cap {slo / 1000:.0f} s",
+        )
+    ax.set_xlabel("Committee size")
+    ax.set_ylabel("Throughput (tx/s)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    out = join(directory, "tps-vs-committee.pdf")
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def _plot_robustness(agg, directory, plt) -> str:
+    by_nodes: dict[tuple, list] = {}
+    tx_size = _uniform_tx_size(agg)
+    for (nodes, faults, ts, rate), m in agg.items():
+        by_nodes.setdefault((nodes, faults), []).append(
+            (rate, m["e2e_tps"]["mean"], m["e2e_tps"]["stdev"])
+        )
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for (nodes, faults), pts in sorted(by_nodes.items()):
+        pts.sort()
+        label = f"{int(nodes)} nodes" + (f" ({int(faults)} faulty)" if faults else "")
+        ax.errorbar(
+            [p[0] for p in pts],
+            [p[1] for p in pts],
+            yerr=[p[2] for p in pts],
+            marker="x",
+            capsize=3,
+            label=label,
+        )
+    lo, hi = ax.get_xlim()
+    ax.plot([lo, hi], [lo, hi], ls=":", c="gray", label="ideal")
+    ax.set_xlabel("Input rate (tx/s)")
+    ax.set_ylabel("Throughput (tx/s)")
+    ax.legend()
+    ax.grid(True, alpha=0.3)
+    _mbps_axis(ax, tx_size)
+    fig.tight_layout()
+    out = join(directory, "robustness.pdf")
+    fig.savefig(out)
+    plt.close(fig)
+    return out
+
+
+def plot_results(
+    directory: str = "results", max_latency_ms=(2_000, 5_000)
+) -> list[str]:
     import matplotlib
 
     matplotlib.use("Agg")
@@ -20,31 +144,12 @@ def plot_results(directory: str = "results") -> None:
     agg = aggregate_results(directory)
     if not agg:
         print(f"no result files in {directory}")
-        return
-
-    # Latency vs throughput, one line per committee size.
-    by_nodes: dict[float, list] = {}
-    for (nodes, faults, tx_size, rate), metrics in agg.items():
-        by_nodes.setdefault(nodes, []).append(
-            (
-                metrics["e2e_tps"]["mean"],
-                metrics["e2e_latency"]["mean"],
-                metrics["e2e_tps"]["stdev"],
-                metrics["e2e_latency"]["stdev"],
-            )
-        )
-    fig, ax = plt.subplots(figsize=(6, 4))
-    for nodes, pts in sorted(by_nodes.items()):
-        pts.sort()
-        xs = [p[0] for p in pts]
-        ys = [p[1] / 1000.0 for p in pts]
-        yerr = [p[3] / 1000.0 for p in pts]
-        ax.errorbar(xs, ys, yerr=yerr, marker="o", capsize=3, label=f"{int(nodes)} nodes")
-    ax.set_xlabel("Throughput (tx/s)")
-    ax.set_ylabel("Latency (s)")
-    ax.legend()
-    ax.grid(True, alpha=0.3)
-    fig.tight_layout()
-    out = join(directory, "latency-vs-throughput.pdf")
-    fig.savefig(out)
-    print(f"wrote {out}")
+        return []
+    outs = [
+        _plot_latency(agg, directory, plt),
+        _plot_tps_vs_committee(agg, directory, plt, max_latency_ms),
+        _plot_robustness(agg, directory, plt),
+    ]
+    for o in outs:
+        print(f"wrote {o}")
+    return outs

@@ -33,6 +33,7 @@ class Consensus:
         mempool_channel: asyncio.Queue,
         commit_channel: asyncio.Queue,
         core_channel: asyncio.Queue | None = None,
+        verification_service=None,
     ) -> Core:
         """Boot the consensus plane; returns the Core (its actor task is
         spawned). The committee addresses are this plane's listen ports.
@@ -78,6 +79,7 @@ class Consensus:
             core_channel,
             network_tx,
             commit_channel,
+            verification_service=verification_service,
         )
         spawn(core.run(), name="consensus-core")
         log.info(

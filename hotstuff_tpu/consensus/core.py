@@ -67,11 +67,20 @@ class Core:
         core_channel: asyncio.Queue,
         network_tx: asyncio.Queue,
         commit_channel: asyncio.Queue,
+        verification_service=None,
     ) -> None:
+        from ..crypto.batch_service import BatchVerificationService
+
         self.name = name
         self.committee = committee
         self.parameters = parameters
         self.signature_service = signature_service
+        # Off-loop batched verification: QC/TC/vote signature checks coalesce
+        # into backend dispatches in a worker thread instead of blocking the
+        # select loop (the seam the reference gets from tokio's threadpool).
+        self.verification_service = (
+            verification_service or BatchVerificationService()
+        )
         self.store = store
         self.leader_elector = leader_elector
         self.mempool_driver = mempool_driver
@@ -275,7 +284,7 @@ class Core:
         ensure(
             block.author == leader, WrongLeaderError(block.round, block.author, leader)
         )
-        block.verify(self.committee)
+        await block.verify_async(self.committee, self.verification_service)
         await self._process_qc(block.qc)
         if block.tc is not None:
             await self._advance_round(block.tc.round)
@@ -288,7 +297,7 @@ class Core:
     async def _handle_vote(self, vote: Vote) -> None:
         if vote.round < self.round:
             return
-        vote.verify(self.committee)
+        await vote.verify_async(self.committee, self.verification_service)
         qc = self.aggregator.add_vote(vote)
         if qc is not None:
             log.debug("assembled %s", qc)
@@ -299,7 +308,7 @@ class Core:
     async def _handle_timeout(self, timeout: Timeout) -> None:
         if timeout.round < self.round:
             return
-        timeout.verify(self.committee)
+        await timeout.verify_async(self.committee, self.verification_service)
         await self._process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
@@ -311,7 +320,7 @@ class Core:
 
     async def _handle_tc(self, tc: TC) -> None:
         """A TC received directly (core.rs:438-444)."""
-        tc.verify(self.committee)
+        await tc.verify_async(self.committee, self.verification_service)
         await self._advance_round(tc.round)
         if self.leader_elector.get_leader(self.round) == self.name:
             await self._generate_proposal(tc)
@@ -362,3 +371,9 @@ class Core:
                     log.warning("unexpected core message: %r", value)
             except ConsensusError as e:
                 log.warning("%s", e)
+            except Exception as e:
+                # A transient failure (e.g. a crypto-backend error surfaced
+                # through verify_async) must not kill the consensus actor:
+                # the message is dropped, the protocol's retry machinery
+                # (pacemaker, sync tickers) recovers the state.
+                log.error("consensus core error: %r", e)

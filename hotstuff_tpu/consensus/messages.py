@@ -78,9 +78,10 @@ class QC:
     def signed_digest(self) -> Digest:
         return _vote_digest(self.hash, self.round)
 
-    def verify(self, committee: Committee) -> None:
-        """Quorum + uniqueness checks, then BATCH signature verification --
-        the per-block crypto hot spot (messages.rs:180-198). Raises on failure."""
+    def check_quorum(self, committee: Committee) -> None:
+        """Structural checks only: authority uniqueness, known stake, 2f+1
+        weight (messages.rs:180-196). Signature checks are separate so the
+        async path can batch them through the verification service."""
         weight = 0
         used: set[PublicKey] = set()
         for name, _ in self.votes:
@@ -90,8 +91,27 @@ class QC:
             used.add(name)
             weight += stake
         ensure(weight >= committee.quorum_threshold(), QCRequiresQuorumError())
+
+    def verify(self, committee: Committee) -> None:
+        """Quorum + uniqueness checks, then BATCH signature verification --
+        the per-block crypto hot spot (messages.rs:180-198). Raises on failure."""
+        self.check_quorum(committee)
         ok = Signature.verify_batch(self.signed_digest(), list(self.votes))
         ensure(ok, InvalidSignatureError("QC batch verification failed"))
+
+    def signed_items(self) -> tuple[list[bytes], list[tuple[PublicKey, Signature]]]:
+        """(messages, (pk, sig)) triples for batched service verification."""
+        d = self.signed_digest().data
+        return [d] * len(self.votes), list(self.votes)
+
+    async def verify_async(self, committee: Committee, service) -> None:
+        """verify() with the signature batch routed through the
+        BatchVerificationService (off-loop, coalesced with other pending
+        requests) instead of a synchronous backend call in the actor loop."""
+        self.check_quorum(committee)
+        msgs, pairs = self.signed_items()
+        mask = await service.verify_group(msgs, pairs, urgent=True)
+        ensure(all(mask), InvalidSignatureError("QC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
         w.fixed(self.hash.data, 32)
@@ -117,7 +137,7 @@ class TC:
     def high_qc_rounds(self) -> list[Round]:
         return [r for _, _, r in self.votes]
 
-    def verify(self, committee: Committee) -> None:
+    def check_quorum(self, committee: Committee) -> None:
         weight = 0
         used: set[PublicKey] = set()
         for name, _, _ in self.votes:
@@ -127,11 +147,24 @@ class TC:
             used.add(name)
             weight += stake
         ensure(weight >= committee.quorum_threshold(), TCRequiresQuorumError())
+
+    def signed_items(self) -> tuple[list[bytes], list[tuple[PublicKey, Signature]]]:
         # Distinct messages (each binds its own high_qc_round): verify_batch_alt.
         msgs = [_timeout_digest(self.round, hr).data for _, _, hr in self.votes]
         pairs = [(pk, sig) for pk, sig, _ in self.votes]
+        return msgs, pairs
+
+    def verify(self, committee: Committee) -> None:
+        self.check_quorum(committee)
+        msgs, pairs = self.signed_items()
         ok = Signature.verify_batch_alt(msgs, pairs)
         ensure(ok, InvalidSignatureError("TC batch verification failed"))
+
+    async def verify_async(self, committee: Committee, service) -> None:
+        self.check_quorum(committee)
+        msgs, pairs = self.signed_items()
+        mask = await service.verify_group(msgs, pairs, urgent=True)
+        ensure(all(mask), InvalidSignatureError("TC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
         w.u64(self.round)
@@ -227,6 +260,38 @@ class Block:
         if self.tc is not None:
             self.tc.verify(committee)
 
+    async def verify_async(self, committee: Committee, service) -> None:
+        """verify() with ALL signature checks (author + embedded QC + embedded
+        TC) submitted as ONE group to the BatchVerificationService: a single
+        coalesced backend dispatch per block instead of three synchronous
+        calls in the consensus actor loop."""
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        msgs: list[bytes] = [self.digest().data]
+        pairs: list[tuple[PublicKey, Signature]] = [(self.author, self.signature)]
+        qc_lo = qc_hi = tc_lo = tc_hi = len(msgs)
+        if not self.qc.is_genesis():
+            self.qc.check_quorum(committee)
+            m, p = self.qc.signed_items()
+            qc_lo, qc_hi = len(msgs), len(msgs) + len(m)
+            msgs += m
+            pairs += p
+        if self.tc is not None:
+            self.tc.check_quorum(committee)
+            m, p = self.tc.signed_items()
+            tc_lo, tc_hi = len(msgs), len(msgs) + len(m)
+            msgs += m
+            pairs += p
+        mask = await service.verify_group(msgs, pairs, urgent=True)
+        ensure(mask[0], InvalidSignatureError(f"bad block signature B{self.round}"))
+        ensure(
+            all(mask[qc_lo:qc_hi]),
+            InvalidSignatureError("QC batch verification failed"),
+        )
+        ensure(
+            all(mask[tc_lo:tc_hi]),
+            InvalidSignatureError("TC batch verification failed"),
+        )
+
     def encode(self, w: Writer) -> None:
         self.qc.encode(w)
         if self.tc is None:
@@ -282,6 +347,13 @@ class Vote:
         ok = self.signature.verify(self.signed_digest(), self.author)
         ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
 
+    async def verify_async(self, committee: Committee, service) -> None:
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        ok = await service.verify(
+            self.signed_digest().data, self.author, self.signature
+        )
+        ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
+
     def encode(self, w: Writer) -> None:
         w.fixed(self.hash.data, 32)
         w.u64(self.round)
@@ -324,6 +396,23 @@ class Timeout:
         ensure(ok, InvalidSignatureError(f"bad timeout signature T{self.round}"))
         if not self.high_qc.is_genesis():
             self.high_qc.verify(committee)
+
+    async def verify_async(self, committee: Committee, service) -> None:
+        """Timeout signature + embedded high_qc votes as one service group."""
+        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        msgs: list[bytes] = [self.signed_digest().data]
+        pairs: list[tuple[PublicKey, Signature]] = [(self.author, self.signature)]
+        if not self.high_qc.is_genesis():
+            self.high_qc.check_quorum(committee)
+            m, p = self.high_qc.signed_items()
+            msgs += m
+            pairs += p
+        mask = await service.verify_group(msgs, pairs, urgent=True)
+        ensure(mask[0], InvalidSignatureError(f"bad timeout signature T{self.round}"))
+        ensure(
+            all(mask[1:]),
+            InvalidSignatureError("QC batch verification failed"),
+        )
 
     def encode(self, w: Writer) -> None:
         self.high_qc.encode(w)

@@ -86,11 +86,15 @@ def set_backend(backend: CryptoBackend) -> CryptoBackend:
 
 
 def make_backend(kind: str, **kwargs) -> CryptoBackend:
-    """Factory used by the node CLI's --crypto flag (cpu | tpu)."""
+    """Factory used by the node CLI's --crypto flag (cpu | tpu | remote)."""
     if kind == "cpu":
         return CpuBackend()
     if kind == "tpu":
         from .tpu_backend import TpuBackend
 
         return TpuBackend(**kwargs)
+    if kind == "remote":
+        from .remote import RemoteBackend
+
+        return RemoteBackend(**kwargs)
     raise ValueError(f"unknown crypto backend {kind!r}")

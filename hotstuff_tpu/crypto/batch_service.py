@@ -4,41 +4,69 @@ The north-star constraint (BASELINE.json): TPU batch verification must not
 regress consensus latency — QC formation blocks round advancement, so
 per-vote verification cannot wait for a large batch to fill. This actor
 generalises the reference's SignatureService request/oneshot seam
-(crypto/src/lib.rs:226-252) to verification: callers await single
-(message, key, signature) checks; the actor accumulates concurrent requests
-and flushes to the active CryptoBackend when either
+(crypto/src/lib.rs:226-252) to verification: callers submit GROUPS of
+(message, key, signature) triples (a QC's votes, one synthetic payload
+batch, or a single vote) and await a per-item validity mask. The actor
+concatenates pending groups and flushes to the active CryptoBackend when
 
-  * the pending batch reaches `max_batch` (size flush, TPU-efficient), or
-  * the oldest request is `max_delay` seconds old (deadline flush, keeps
-    p99 latency bounded at low rates — SURVEY.md §7 "hard parts" item 1).
+  * the pending total reaches `max_batch` (size flush, TPU-efficient),
+  * the oldest group is `max_delay` seconds old (deadline flush, keeps
+    p99 latency bounded at low rates — SURVEY.md §7 "hard parts" item 1), or
+  * an URGENT group is pending (consensus-critical: QC/TC/vote checks gate
+    round advancement, so they flush after an opportunistic drain instead
+    of waiting out the deadline).
 
 The backend call runs in a worker thread so the TPU dispatch never blocks
 the event loop (the mempool/consensus cores keep processing while a batch
-is in flight — the same pipelining the reference gets from tokio).
+is in flight — the same pipelining the reference gets from tokio). Groups
+are enqueued whole (one queue item, one future per group), so per-item
+asyncio overhead is O(1) per group, not O(n) — at 100k+ sigs/s the Python
+queue would otherwise dominate the TPU kernel.
 """
 
 from __future__ import annotations
 
 import asyncio
+import logging
+from dataclasses import dataclass, field
 from typing import Sequence
 
 from .backend import CryptoBackend, get_backend
 from .primitives import PublicKey, Signature
+
+log = logging.getLogger("hotstuff.crypto")
+
+
+@dataclass
+class _Group:
+    messages: list[bytes]
+    keys: list[PublicKey]
+    signatures: list[Signature]
+    urgent: bool
+    future: asyncio.Future = field(default_factory=lambda: asyncio.get_running_loop().create_future())
+
+    def __len__(self) -> int:
+        return len(self.messages)
 
 
 class BatchVerificationService:
     def __init__(
         self,
         backend: CryptoBackend | None = None,
-        max_batch: int = 4096,
+        max_batch: int = 8192,
         max_delay: float = 0.002,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
         self.max_delay = max_delay
-        self._queue: asyncio.Queue = asyncio.Queue()
+        self._queue: asyncio.Queue[_Group] = asyncio.Queue()
         self._task: asyncio.Task | None = None
-        self.stats = {"flushes": 0, "size_flushes": 0, "verified": 0}
+        self.stats = {
+            "flushes": 0,
+            "size_flushes": 0,
+            "urgent_flushes": 0,
+            "verified": 0,
+        }
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
@@ -50,64 +78,90 @@ class BatchVerificationService:
     def backend(self) -> CryptoBackend:
         return self._backend or get_backend()
 
-    async def verify(
-        self, message: bytes, key: PublicKey, signature: Signature
-    ) -> bool:
-        """Await a single verification (batched under the hood)."""
-        self._ensure_task()
-        fut = asyncio.get_running_loop().create_future()
-        await self._queue.put((message, key, signature, fut))
-        return await fut
+    # -- submission API ------------------------------------------------------
 
-    async def verify_many(
+    async def verify_group(
         self,
         messages: Sequence[bytes],
         pairs: Sequence[tuple[PublicKey, Signature]],
+        urgent: bool = False,
     ) -> list[bool]:
-        """Submit a correlated group (e.g. one QC's votes); resolves when
-        every member's result is in (they may span multiple flushes)."""
+        """Submit a correlated group (e.g. one QC's votes or one synthetic
+        payload batch); resolves to the per-item validity mask once the
+        group's flush completes."""
+        if not messages:
+            return []
         self._ensure_task()
-        loop = asyncio.get_running_loop()
-        futs = [loop.create_future() for _ in messages]
-        for m, (pk, sig), fut in zip(messages, pairs, futs):
-            await self._queue.put((m, pk, sig, fut))
-        return list(await asyncio.gather(*futs))
+        group = _Group(
+            list(messages),
+            [pk for pk, _ in pairs],
+            [sig for _, sig in pairs],
+            urgent,
+        )
+        await self._queue.put(group)
+        return await group.future
+
+    async def verify(
+        self,
+        message: bytes,
+        key: PublicKey,
+        signature: Signature,
+        urgent: bool = True,
+    ) -> bool:
+        """Await a single verification (batched under the hood)."""
+        mask = await self.verify_group([message], [(key, signature)], urgent)
+        return mask[0]
+
+    # -- flush loop ----------------------------------------------------------
 
     async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
         while True:
             first = await self._queue.get()
-            batch = [first]
-            deadline = asyncio.get_running_loop().time() + self.max_delay
-            while len(batch) < self.max_batch:
-                timeout = deadline - asyncio.get_running_loop().time()
+            groups = [first]
+            total = len(first)
+            urgent = first.urgent
+            deadline = loop.time() + self.max_delay
+            while total < self.max_batch:
+                # Opportunistic drain of whatever is already enqueued.
+                while not self._queue.empty() and total < self.max_batch:
+                    g = self._queue.get_nowait()
+                    groups.append(g)
+                    total += len(g)
+                    urgent |= g.urgent
+                if urgent or total >= self.max_batch:
+                    break
+                timeout = deadline - loop.time()
                 if timeout <= 0:
                     break
                 try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout)
-                    )
+                    g = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
-            # opportunistic drain of anything already enqueued
-            while len(batch) < self.max_batch and not self._queue.empty():
-                batch.append(self._queue.get_nowait())
+                groups.append(g)
+                total += len(g)
+                urgent |= g.urgent
 
-            msgs = [m for m, _, _, _ in batch]
-            keys = [k for _, k, _, _ in batch]
-            sigs = [s for _, _, s, _ in batch]
+            msgs = [m for g in groups for m in g.messages]
+            keys = [k for g in groups for k in g.keys]
+            sigs = [s for g in groups for s in g.signatures]
             backend = self.backend
             try:
                 mask = await asyncio.to_thread(
                     backend.verify_batch_mask, msgs, keys, sigs
                 )
             except Exception as exc:  # backend failure must not hang callers
-                for _, _, _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(exc)
+                for g in groups:
+                    if not g.future.done():
+                        g.future.set_exception(exc)
                 continue
             self.stats["flushes"] += 1
-            self.stats["size_flushes"] += len(batch) >= self.max_batch
-            self.stats["verified"] += len(batch)
-            for (_, _, _, fut), ok in zip(batch, mask):
-                if not fut.cancelled():
-                    fut.set_result(bool(ok))
+            self.stats["size_flushes"] += total >= self.max_batch
+            self.stats["urgent_flushes"] += urgent
+            self.stats["verified"] += total
+            lo = 0
+            for g in groups:
+                hi = lo + len(g)
+                if not g.future.cancelled():
+                    g.future.set_result([bool(b) for b in mask[lo:hi]])
+                lo = hi

@@ -10,7 +10,9 @@ equivalent); the control flow stays in Python, the per-byte work in C++.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import logging
+import os
 import pathlib
 import subprocess
 import threading
@@ -39,15 +41,34 @@ def _build() -> pathlib.Path | None:
                 check=True,
                 capture_output=True,
             )
-        newest_src = max(s.stat().st_mtime for s in srcs)
-        if not _SO_PATH.exists() or _SO_PATH.stat().st_mtime < newest_src:
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
-                + [str(s) for s in srcs]
-                + ["-o", str(_SO_PATH)],
-                check=True,
-                capture_output=True,
-            )
+        # Gate rebuilds on a content hash of the sources, not mtimes:
+        # git checkouts reset mtimes, so an mtime check can silently load
+        # a stale artifact that no longer matches the sources.
+        digest = hashlib.sha256()
+        for s in [*srcs, hdr]:
+            digest.update(s.name.encode())
+            digest.update(s.read_bytes())
+        want = digest.hexdigest()
+        stamp = _SO_PATH.with_suffix(".so.hash")
+        # Cross-PROCESS lock: a local committee boots N nodes concurrently
+        # and each may attempt the build; without it, parallel g++ runs
+        # clobber the .so while another process dlopens it.
+        import fcntl
+
+        with open(_NATIVE_DIR / ".build.lock", "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            have = stamp.read_text().strip() if stamp.exists() else None
+            if not _SO_PATH.exists() or have != want:
+                tmp = _SO_PATH.with_suffix(f".so.tmp{os.getpid()}")
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+                    + [str(s) for s in srcs]
+                    + ["-o", str(tmp)],
+                    check=True,
+                    capture_output=True,
+                )
+                tmp.replace(_SO_PATH)
+                stamp.write_text(want + "\n")
         return _SO_PATH
     except (subprocess.CalledProcessError, OSError) as e:
         log.warning("native build failed, using Python path: %s", e)
@@ -64,8 +85,34 @@ def get_lib():
         so = _build()
         if so is None:
             return None
-        lib = ctypes.CDLL(str(so))
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError as e:  # corrupt/partial artifact must not kill boot
+            log.warning("loading native library failed, using Python path: %s", e)
+            return None
         lib.hs_stage_batch.restype = ctypes.c_int
+        # store engine (native/store.cpp)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.hs_store_open.restype = ctypes.c_void_p
+        lib.hs_store_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.hs_store_write.restype = ctypes.c_int
+        lib.hs_store_write.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int64, u8p, ctypes.c_int64,
+        ]
+        lib.hs_store_read.restype = ctypes.c_int64
+        lib.hs_store_read.argtypes = [
+            ctypes.c_void_p, u8p, ctypes.c_int64, ctypes.POINTER(u8p),
+        ]
+        lib.hs_store_contains.restype = ctypes.c_int
+        lib.hs_store_contains.argtypes = [ctypes.c_void_p, u8p, ctypes.c_int64]
+        lib.hs_store_len.restype = ctypes.c_int64
+        lib.hs_store_len.argtypes = [ctypes.c_void_p]
+        lib.hs_store_compact.restype = ctypes.c_int64
+        lib.hs_store_compact.argtypes = [ctypes.c_void_p]
+        lib.hs_store_close.restype = None
+        lib.hs_store_close.argtypes = [ctypes.c_void_p]
+        lib.hs_free.restype = None
+        lib.hs_free.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

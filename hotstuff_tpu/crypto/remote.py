@@ -1,0 +1,256 @@
+"""Crypto sidecar: one process owns the TPU, many nodes share it.
+
+A TPU chip is process-exclusive under JAX, but a local committee (and any
+co-located deployment) runs several node processes per machine. The
+reference's answer to async crypto is the SignatureService request/reply
+seam (crypto/src/lib.rs:226-252); this module generalises that seam ACROSS
+processes: a sidecar process holds the TpuBackend and serves batch
+verification over a local TCP socket, and nodes install a `RemoteBackend`
+that ships large batches to the sidecar while verifying small
+(consensus-critical, sub-crossover) batches on the local CPU — the same
+crossover policy TpuBackend applies in-process (SURVEY.md §7 hard-part 3).
+
+Server-side, requests from ALL nodes funnel through one
+BatchVerificationService, so batches coalesce across the whole committee
+before hitting the device — strictly better device utilisation than any
+per-node dispatch could get.
+
+Wire protocol (little-endian, one request per round-trip per connection):
+  request:  u32 n, then n x { u32 mlen, msg, 32 B pk, 64 B sig }
+  response: u32 n, then n x u8 validity
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import struct
+import threading
+from typing import Sequence
+
+from .backend import CpuBackend, CryptoBackend
+from .primitives import PublicKey, Signature
+
+log = logging.getLogger("hotstuff.crypto")
+
+
+def _encode_request(
+    messages: Sequence[bytes],
+    keys: Sequence[PublicKey],
+    signatures: Sequence[Signature],
+) -> bytes:
+    parts = [struct.pack("<I", len(messages))]
+    for m, k, s in zip(messages, keys, signatures):
+        parts.append(struct.pack("<I", len(m)))
+        parts.append(m)
+        parts.append(k.data if isinstance(k, PublicKey) else k)
+        parts.append(s.data if isinstance(s, Signature) else s)
+    return b"".join(parts)
+
+
+class RemoteBackend(CryptoBackend):
+    """CryptoBackend that dispatches big batches to the sidecar process.
+
+    Small batches (below `crossover`) verify on the local CPU: a localhost
+    round-trip plus device dispatch would only add latency to the
+    consensus-critical QC path. Falls back to CPU entirely if the sidecar
+    is unreachable (a crypto sidecar outage must not halt the protocol)."""
+
+    name = "remote"
+
+    def __init__(
+        self, addr: tuple[str, int], crossover: int = 64, timeout: float = 30.0
+    ):
+        self.addr = addr
+        self.crossover = crossover
+        self.timeout = timeout
+        self._cpu = CpuBackend()
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self.stats = {"remote_batches": 0, "remote_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(self.addr, timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
+        buf = bytearray()
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("sidecar closed connection")
+            buf += chunk
+        return bytes(buf)
+
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> list[bool]:
+        n = len(messages)
+        if n == 0:
+            return []
+        if n < self.crossover:
+            self.stats["cpu_batches"] += 1
+            self.stats["cpu_sigs"] += n
+            return self._cpu.verify_batch_mask(messages, keys, signatures)
+        payload = _encode_request(messages, keys, signatures)
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    sock = self._connect()
+                    sock.sendall(payload)
+                    (count,) = struct.unpack("<I", self._recv_exact(sock, 4))
+                    if count != n:
+                        raise ConnectionError("sidecar count mismatch")
+                    mask = self._recv_exact(sock, n)
+                    self.stats["remote_batches"] += 1
+                    self.stats["remote_sigs"] += n
+                    return [b != 0 for b in mask]
+                except (OSError, ConnectionError) as e:
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+                    if attempt == 1:
+                        log.warning(
+                            "sidecar unreachable (%s); falling back to CPU", e
+                        )
+        self.stats["cpu_batches"] += 1
+        self.stats["cpu_sigs"] += n
+        return self._cpu.verify_batch_mask(messages, keys, signatures)
+
+
+# ---------------------------------------------------------------------------
+# Sidecar server
+
+
+async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
+    return await reader.readexactly(n)
+
+
+async def _handle_connection(reader, writer, service, urgent_below: int):
+    peer = writer.get_extra_info("peername")
+    log.debug("sidecar connection from %s", peer)
+    try:
+        while True:
+            try:
+                (n,) = struct.unpack("<I", await _read_exact(reader, 4))
+            except (asyncio.IncompleteReadError, ConnectionResetError):
+                break
+            msgs: list[bytes] = []
+            pairs: list[tuple[PublicKey, Signature]] = []
+            for _ in range(n):
+                (mlen,) = struct.unpack("<I", await _read_exact(reader, 4))
+                m = await _read_exact(reader, mlen)
+                pk = PublicKey(await _read_exact(reader, 32))
+                sig = Signature(await _read_exact(reader, 64))
+                msgs.append(m)
+                pairs.append((pk, sig))
+            # Small requests are consensus-critical (QC/TC checks above the
+            # client's crossover but still latency-bound): flush immediately.
+            mask = await service.verify_group(
+                msgs, pairs, urgent=n < urgent_below
+            )
+            writer.write(struct.pack("<I", n) + bytes(int(b) for b in mask))
+            await writer.drain()
+    finally:
+        writer.close()
+
+
+def warmup_backend(backend: CryptoBackend, max_batch: int = 8192) -> None:
+    """Pre-compile every verifier bucket width BEFORE serving: a cold jit
+    specialisation (~20-40 s on TPU) hitting mid-run would stall the whole
+    committee's verification pipeline. With the persistent compilation cache
+    enabled this is fast on every boot after the first."""
+    import random
+
+    from .primitives import Digest, Signature, generate_keypair
+
+    verifier = getattr(backend, "_verifier", None)
+    if verifier is None:
+        return
+    rng = random.Random(11)
+    pk, sk = generate_keypair(rng)
+    digest = Digest.of(b"warmup")
+    sig = Signature.new(digest, sk)
+    width = getattr(verifier, "min_bucket", 128)
+    while True:
+        log.info("warmup: compiling bucket width %s", width)
+        backend.verify_batch_mask(
+            [digest.data] * width, [pk] * width, [sig] * width
+        )
+        if width >= max_batch or width >= verifier.max_bucket:
+            break
+        width *= 2
+
+
+async def serve(
+    addr: tuple[str, int],
+    backend: CryptoBackend,
+    max_batch: int = 8192,
+    max_delay: float = 0.002,
+    urgent_below: int = 256,
+) -> None:
+    """Run the sidecar server forever. One BatchVerificationService shared by
+    every connection: batches coalesce across the whole committee."""
+    from .batch_service import BatchVerificationService
+
+    service = BatchVerificationService(
+        backend, max_batch=max_batch, max_delay=max_delay
+    )
+
+    async def handler(reader, writer):
+        await _handle_connection(reader, writer, service, urgent_below)
+
+    server = await asyncio.start_server(handler, addr[0], addr[1])
+    # NOTE: parsed by the benchmark harness to detect readiness.
+    log.info("Crypto sidecar (%s) successfully booted on %s:%s", backend.name, addr[0], addr[1])
+    async with server:
+        await server.serve_forever()
+
+
+def main(argv: list[str] | None = None) -> None:
+    import argparse
+
+    from ..utils.logging import setup_logging
+    from .backend import make_backend
+
+    p = argparse.ArgumentParser(description="crypto verification sidecar")
+    p.add_argument("-v", "--verbose", action="count", default=2)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--backend", default="tpu", choices=["cpu", "tpu"])
+    p.add_argument("--max-batch", type=int, default=8192)
+    p.add_argument("--max-delay", type=float, default=0.002)
+    p.add_argument(
+        "--no-warmup", action="store_true", help="skip bucket pre-compilation"
+    )
+    args = p.parse_args(argv)
+    setup_logging(args.verbose)
+    if args.backend == "tpu":
+        from ..ops import enable_persistent_cache
+
+        enable_persistent_cache()
+    backend = make_backend(args.backend)
+    if not args.no_warmup:
+        warmup_backend(backend, args.max_batch)
+    asyncio.run(
+        serve(
+            (args.host, args.port),
+            backend,
+            max_batch=args.max_batch,
+            max_delay=args.max_delay,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,9 @@ class TpuBackend(CryptoBackend):
         sharded: bool = False,
     ):
         # import lazily so CPU-only processes never touch jax
+        from ..ops import enable_persistent_cache
+
+        enable_persistent_cache()
         if sharded or mesh is not None:
             from ..parallel.mesh import ShardedEd25519Verifier
 

@@ -28,6 +28,14 @@ from ..consensus.mempool_driver import (
     PayloadStatus,
 )
 from .config import MempoolCommittee, MempoolParameters
+from .errors import (
+    InvalidPayloadSignatureError,
+    MempoolError,
+    PayloadTooBigError,
+    QueueFullError,
+    UnknownAuthorityError,
+    ensure,
+)
 from .messages import OwnPayload, Payload, PayloadRequest
 from .messages import encode_mempool_message
 from .payload_maker import PayloadMaker
@@ -79,7 +87,11 @@ class Core:
         core_channel: asyncio.Queue,
         consensus_mempool_channel: asyncio.Queue,
         network_tx: asyncio.Queue,
+        verification_service=None,
+        max_inflight_verifications: int = 8,
     ) -> None:
+        from ..crypto.batch_service import BatchVerificationService
+
         self.name = name
         self.committee = committee
         self.parameters = parameters
@@ -89,8 +101,24 @@ class Core:
         self.core_channel = core_channel
         self.consensus_mempool_channel = consensus_mempool_channel
         self.network_tx = network_tx
+        # Batched off-loop verification: synthetic workload batches and
+        # foreign-payload signatures run as bounded background tasks so a
+        # device dispatch never stalls the core's select loop (the reference
+        # blocks its tokio task here, mempool/src/core.rs:135-148 — this is
+        # strictly more pipelined).
+        self.verification_service = (
+            verification_service or BatchVerificationService()
+        )
+        self._verify_sem = asyncio.Semaphore(max_inflight_verifications)
+        self._inflight: set[asyncio.Task] = set()
         # Undelivered payload digests, insertion-ordered (core.rs:50 queue).
         self.queue: dict[Digest, None] = {}
+        # Digests already consumed by consensus cleanup. Background payload
+        # verification may finish AFTER the block containing the payload
+        # committed; inserting then would re-propose a committed payload.
+        # Bounded insertion-ordered set (evicts oldest).
+        self._cleaned: dict[Digest, None] = {}
+        self._cleaned_cap = 4 * parameters.queue_capacity
         self.pool: SyntheticPool | None = None
         if parameters.benchmark_mode:
             log.info(
@@ -108,22 +136,53 @@ class Core:
 
     # -- benchmark workload --------------------------------------------------
 
-    def _verify_synthetic_batch(self, kind: str, n: int) -> None:
-        """The fork's injected hot path (mempool/src/core.rs:135-148,211-224).
+    def _synthetic_coro(self, kind: str, n: int):
+        """The fork's injected hot path (mempool/src/core.rs:135-148,211-224):
+        returns the verification coroutine (or None when inactive). The log
+        line here is the single source of the votes/sec metric.
         NOTE: This log entry is used to compute performance."""
         if self.pool is None or n == 0:
-            return
+            return None
         log.info("Verifying %s transaction batch. Size: %s", kind, n)
         msgs, pairs = self.pool.take(n)
-        ok = Signature.verify_batch_alt(msgs, pairs)
-        if not ok:
+        return self._run_synthetic(msgs, pairs)
+
+    async def _submit_synthetic_batch(self, kind: str, n: int) -> None:
+        """Run the synthetic batch as a bounded background task — multiple
+        batches stay in flight while the core keeps processing."""
+        coro = self._synthetic_coro(kind, n)
+        if coro is not None:
+            await self._spawn_verification(coro)
+
+    async def _run_synthetic(self, msgs, pairs) -> None:
+        mask = await self.verification_service.verify_group(
+            msgs, pairs, urgent=False
+        )
+        if not all(mask):
             log.error("synthetic batch verification failed (backend bug?)")
+
+    async def _spawn_verification(self, coro) -> None:
+        """Run `coro` in a background task, capped at
+        `max_inflight_verifications` (acquiring the semaphore HERE gives
+        backpressure: the core pauses intake only when the pipeline is full)."""
+        await self._verify_sem.acquire()
+        task = spawn(self._release_after(coro), name="mempool-verify")
+        self._inflight.add(task)
+        task.add_done_callback(self._inflight.discard)
+
+    async def _release_after(self, coro) -> None:
+        try:
+            await coro
+        except Exception as e:  # must not kill the task group silently
+            log.warning("background verification error: %r", e)
+        finally:
+            self._verify_sem.release()
 
     # -- payload handling ----------------------------------------------------
 
     async def _handle_own_payload(self, payload: Payload) -> Digest:
         digest = payload.digest()
-        self._verify_synthetic_batch("OWN", len(payload.transactions))
+        await self._submit_synthetic_batch("OWN", len(payload.transactions))
         # NOTE: These log entries are used to compute performance.
         log.info("Payload %s contains %s B", digest, payload.size())
         for sample_id in payload.sample_tx_ids():
@@ -140,24 +199,38 @@ class Core:
         return digest
 
     async def _handle_others_payload(self, payload: Payload) -> None:
-        """Byzantine-input checks at ingress (core.rs:193-234)."""
-        if not self.committee.exists(payload.author):
-            log.warning("payload from unknown authority %s", payload.author.short())
-            return
-        if payload.size() > self.parameters.max_payload_size:
-            log.warning("payload exceeds size cap, dropping")
-            return
-        if not payload.verify(self.committee):
-            log.warning("invalid payload signature from %s", payload.author.short())
-            return
-        self._verify_synthetic_batch("OTHER", len(payload.transactions))
+        """Byzantine-input checks at ingress (core.rs:193-234). Structural
+        checks raise typed MempoolErrors synchronously; the signature check
+        and synthetic workload run in a bounded background task, after which
+        the payload is stored (waking any notify_read synchronizer waiters)
+        and queued."""
+        ensure(
+            self.committee.exists(payload.author),
+            UnknownAuthorityError(payload.author.short()),
+        )
+        ensure(
+            payload.size() <= self.parameters.max_payload_size,
+            PayloadTooBigError(payload.size(), self.parameters.max_payload_size),
+        )
+        await self._spawn_verification(self._finish_others_payload(payload))
+
+    async def _finish_others_payload(self, payload: Payload) -> None:
+        ok = await payload.verify_async(self.committee, self.verification_service)
+        if not ok:
+            raise InvalidPayloadSignatureError(payload.author.short())
+        coro = self._synthetic_coro("OTHER", len(payload.transactions))
+        if coro is not None:
+            await coro  # already inside a bounded background task
         await self._store_payload(payload)
         self._queue_insert(payload.digest())
 
     def _queue_insert(self, digest: Digest) -> None:
-        if len(self.queue) >= self.parameters.queue_capacity:
-            log.warning("mempool queue full, dropping digest")
-            return
+        if digest in self._cleaned:
+            return  # already ordered and cleaned up; do not re-propose
+        ensure(
+            len(self.queue) < self.parameters.queue_capacity,
+            QueueFullError(self.parameters.queue_capacity),
+        )
         self.queue[digest] = None
 
     async def _handle_request(self, request: PayloadRequest) -> None:
@@ -198,6 +271,9 @@ class Core:
         for block in (msg.b0, msg.b1, msg.block):
             for digest in block.payload:
                 self.queue.pop(digest, None)
+                self._cleaned[digest] = None
+        while len(self._cleaned) > self._cleaned_cap:
+            self._cleaned.pop(next(iter(self._cleaned)))
         self.synchronizer.cleanup(msg.b0.round)
 
     # -- main loop -----------------------------------------------------------
@@ -240,5 +316,12 @@ class Core:
                     await self._cleanup(msg)
                 else:
                     log.warning("unexpected mempool message: %r", msg)
+            except MempoolError as e:  # typed Byzantine-input rejection
+                log.warning("%s", e)
             except Exception as e:  # a Byzantine message must not kill the actor
                 log.warning("mempool core error: %r", e)
+
+    async def drain_verifications(self) -> None:
+        """Await all in-flight background verifications (test/shutdown aid)."""
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight), return_exceptions=True)

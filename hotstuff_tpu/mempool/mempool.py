@@ -31,6 +31,7 @@ class Mempool:
         signature_service: SignatureService,
         consensus_mempool_channel: asyncio.Queue,
         consensus_channel: asyncio.Queue,
+        verification_service=None,
     ) -> Core:
         """Boot the mempool plane. `consensus_mempool_channel` carries
         Get/Verify/Cleanup requests FROM consensus; `consensus_channel` lets
@@ -80,6 +81,7 @@ class Mempool:
             core_channel,
             consensus_mempool_channel,
             network_tx,
+            verification_service=verification_service,
         )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)

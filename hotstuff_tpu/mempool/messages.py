@@ -46,6 +46,14 @@ class Payload:
     def verify(self, committee) -> bool:
         return self.signature.verify(self.digest(), self.author)
 
+    async def verify_async(self, committee, service) -> bool:
+        """Signature check through the BatchVerificationService (coalesced
+        off-loop backend dispatch; non-urgent — payload ingress does not gate
+        round advancement the way QC formation does)."""
+        return await service.verify(
+            self.digest().data, self.author, self.signature, urgent=False
+        )
+
     def sample_tx_ids(self) -> list[int]:
         """Sample transactions start with a zero byte followed by a u64 id
         (node/src/client.rs:121-137); used for end-to-end latency tracking."""

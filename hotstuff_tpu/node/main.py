@@ -33,7 +33,12 @@ async def _run_node(args) -> None:
     if args.crypto != "cpu":
         from ..crypto.backend import make_backend, set_backend
 
-        set_backend(make_backend(args.crypto))
+        kwargs = {}
+        if args.crypto == "remote":
+            host, port = args.crypto_addr.rsplit(":", 1)
+            kwargs["addr"] = (host, int(port))
+            kwargs["crossover"] = args.crypto_crossover
+        set_backend(make_backend(args.crypto, **kwargs))
     node = Node(args.committee, args.keys, args.store, args.parameters)
     node.boot()
     await node.analyze_block()
@@ -107,7 +112,20 @@ def main(argv: list[str] | None = None) -> None:
     p_run.add_argument("--committee", required=True)
     p_run.add_argument("--parameters", default=None)
     p_run.add_argument("--store", required=True)
-    p_run.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
+    p_run.add_argument(
+        "--crypto", default="cpu", choices=["cpu", "tpu", "remote"]
+    )
+    p_run.add_argument(
+        "--crypto-addr",
+        default="127.0.0.1:9700",
+        help="sidecar address for --crypto remote (host:port)",
+    )
+    p_run.add_argument(
+        "--crypto-crossover",
+        type=int,
+        default=64,
+        help="batches below this size verify on the local CPU",
+    )
 
     p_deploy = sub.add_parser("deploy", help="in-process local testbed")
     p_deploy.add_argument("--nodes", type=int, required=True)

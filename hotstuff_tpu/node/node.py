@@ -42,6 +42,13 @@ class Node:
         name = self.secret.name
         store = Store(self.store_path)
         signature_service = SignatureService(self.secret.secret)
+        # One verification service per node: consensus QC/TC/vote checks and
+        # mempool payload/synthetic batches coalesce into shared backend
+        # dispatches (the async seam of crypto/src/lib.rs:226-252 generalised
+        # to verification).
+        from ..crypto.batch_service import BatchVerificationService
+
+        verification_service = BatchVerificationService()
         consensus_mempool_channel = channel()
         consensus_core_channel = channel()
 
@@ -53,6 +60,7 @@ class Node:
             signature_service,
             consensus_mempool_channel,
             consensus_core_channel,
+            verification_service=verification_service,
         )
         Consensus.run(
             name,
@@ -63,6 +71,7 @@ class Node:
             consensus_mempool_channel,
             self.commit_channel,
             core_channel=consensus_core_channel,
+            verification_service=verification_service,
         )
         log.info("Node %s successfully booted", name.short())
 

@@ -2,7 +2,35 @@
 verification (the reference crypto hot path, crypto/src/lib.rs:194-220,
 rebuilt as JAX SPMD kernels)."""
 
+import os
+
 from . import field
 from .ed25519 import Ed25519TpuVerifier, prepare_batch
 
-__all__ = ["field", "ed25519", "Ed25519TpuVerifier", "prepare_batch"]
+__all__ = [
+    "field",
+    "ed25519",
+    "Ed25519TpuVerifier",
+    "prepare_batch",
+    "enable_persistent_cache",
+]
+
+
+def enable_persistent_cache(path: str | None = None) -> None:
+    """Persistent XLA compilation cache: each verifier bucket width is a
+    separate jit specialisation (~20-40 s compile on TPU), so a cold process
+    would otherwise stall mid-benchmark on every new width. Safe to call
+    more than once; disable with HOTSTUFF_JAX_CACHE=0."""
+    if os.environ.get("HOTSTUFF_JAX_CACHE", "1") == "0":
+        return
+    import jax
+
+    cache_dir = path or os.environ.get(
+        "HOTSTUFF_JAX_CACHE_DIR",
+        os.path.expanduser("~/.cache/hotstuff_tpu_jax"),
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # older jax without these flags
+        pass

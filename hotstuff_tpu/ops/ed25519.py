@@ -178,8 +178,15 @@ def _lookup_shared(table: np.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
 
 
 def _lookup_per_item(table: jnp.ndarray, onehot: jnp.ndarray) -> jnp.ndarray:
-    """(16,32,B) per-item table x (16,B) one-hot -> (32,B) (VPU masked sum)."""
-    return jnp.einsum("elb,eb->lb", table, onehot)
+    """(16,32,B) per-item table x (16,B) one-hot -> (32,B) (VPU masked sum).
+
+    HIGHEST precision is load-bearing: per-item table limbs reach ~590
+    (beyond bf16-exact integers), so a default-precision einsum lowered to
+    bf16 MXU passes on real TPU would corrupt limbs and verification masks.
+    """
+    return jnp.einsum(
+        "elb,eb->lb", table, onehot, precision=jax.lax.Precision.HIGHEST
+    )
 
 
 def _build_neg_a_table(x_neg, a_y):

@@ -25,7 +25,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """Version-compat shard_map: jax>=0.8 (`jax.shard_map`, check_vma) with
+    fallback to the experimental API (check_rep)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as _sm  # pragma: no cover
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 
 from ..ops import ed25519 as ed
 
@@ -73,7 +86,6 @@ def sharded_verify_fn(mesh: Mesh, dp_axis: str = "dp", kernel: str = "w4"):
         mesh=mesh,
         in_specs=(batch_spec, flat_spec, batch_spec, batch_spec, batch_spec),
         out_specs=(flat_spec, P()),
-        check_rep=False,
     )
     return jax.jit(mapped)
 
@@ -112,7 +124,6 @@ def sharded_qc_verify_fn(mesh: Mesh):
             spec_flat,
         ),
         out_specs=(spec_flat, P("qc")),
-        check_rep=False,
     )
     return jax.jit(mapped)
 
@@ -131,6 +142,11 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
 
             lane = BLOCK
         self.min_bucket = max(self.min_bucket, lane * self._ndev)
+        # max_bucket must stay a multiple of lane*ndev or shard_map cannot
+        # split the capped bucket evenly (e.g. 3 devices: doubling 384
+        # overshoots a 8192 cap that 384 does not divide).
+        align = lane * self._ndev
+        self.max_bucket = max(align, self.max_bucket // align * align)
         self._fn = sharded_verify_fn(
             self.mesh, self.mesh.axis_names[0], self.kernel
         )

@@ -6,37 +6,76 @@ Capability parity with the reference `store` crate (store/src/lib.rs:15-92):
   * NotifyRead registers an obligation resolved by a FUTURE Write of that key
     -- the synchronizers' wait primitive for out-of-order block/payload arrival
 
-The reference persists via rocksdb; here durability comes from an append-only
-length-prefixed log replayed on open (a native C++ log-structured store under
-native/ can be slotted in behind the same command protocol).
+The reference persists via rocksdb; here the data plane is pluggable behind
+the same command protocol:
+  * native C++ log-structured engine (native/store.cpp via ctypes) — hash
+    index + append-only length-prefixed log + crash-safe torn-tail truncate,
+    the default for file-backed stores when the toolchain is available;
+  * pure-Python engine with the same log format (fallback);
+  * plain dict for path-less (in-memory, test) stores.
+
+Both persistent engines COMPACT: when the log grows past an adaptive
+threshold the live keys are rewritten and the file atomically replaced —
+the role rocksdb's background compaction plays in the reference. Without
+it, the safety-state key rewritten every round (consensus/core.py) would
+grow the log and the restart replay time without bound.
 """
 
 from __future__ import annotations
 
 import asyncio
+import ctypes
+import logging
 import os
 import struct
 from collections import defaultdict, deque
 
 from ..utils.actors import channel, spawn
 
+log = logging.getLogger("hotstuff.store")
 
-class Store:
-    """Async KV store handle; cheap to share (all ops go through one queue)."""
+# Compact when the log exceeds this many bytes AND twice the live size.
+MIN_COMPACT_BYTES = 8 * 1024 * 1024
 
-    def __init__(self, path: str | None = None) -> None:
+
+class _MemEngine:
+    """Path-less store: a dict, no durability (tests, MockMempool)."""
+
+    log_bytes = 0
+
+    def __init__(self) -> None:
         self._data: dict[bytes, bytes] = {}
-        self._obligations: dict[bytes, deque[asyncio.Future]] = defaultdict(deque)
-        self._queue = channel()
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._data[key] = value
+
+    def compact(self) -> int:
+        return 0
+
+    def close(self) -> None:
+        pass
+
+
+class _PyLogEngine:
+    """Append-only length-prefixed log + in-memory index (pure Python)."""
+
+    def __init__(self, path: str) -> None:
+        self._data: dict[bytes, bytes] = {}
         self._path = path
-        self._log = None
-        if path is not None:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self._replay(path)
-            self._log = open(path, "ab")
-        self._task = spawn(self._run(), name="store-writer")
+        self._replay(path)
+        # Truncate any torn tail so appended records stay replayable.
+        with open(path, "ab") as f:
+            pass
+        with open(path, "r+b") as f:
+            f.truncate(self._good_offset)
+        self._log = open(path, "ab")
+        self.log_bytes = self._good_offset
 
     def _replay(self, path: str) -> None:
+        self._good_offset = 0
         if not os.path.exists(path):
             return
         with open(path, "rb") as f:
@@ -46,23 +85,174 @@ class Store:
             klen, vlen = struct.unpack_from("<II", buf, pos)
             end = pos + 8 + klen + vlen
             if end > len(buf):
-                break  # torn tail write; ignore
+                break  # torn tail write; dropped by the truncate above
             key = buf[pos + 8 : pos + 8 + klen]
-            val = buf[pos + 8 + klen : end]
-            self._data[key] = val
+            self._data[key] = buf[pos + 8 + klen : end]
             pos = end
+        self._good_offset = pos
+
+    def get(self, key: bytes) -> bytes | None:
+        return self._data.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._data[key] = value
+        self._log.write(struct.pack("<II", len(key), len(value)))
+        self._log.write(key)
+        self._log.write(value)
+        self._log.flush()
+        self.log_bytes += 8 + len(key) + len(value)
+
+    def compact(self) -> int:
+        """Rewrite live keys only; atomic replace via rename. Returns the new
+        log size, or -1 on failure (the log is reopened either way — a failed
+        compaction must leave the engine writable)."""
+        tmp = self._path + ".compact"
+        try:
+            with open(tmp, "wb") as out:
+                for k, v in self._data.items():
+                    out.write(struct.pack("<II", len(k), len(v)))
+                    out.write(k)
+                    out.write(v)
+                out.flush()
+                os.fsync(out.fileno())
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return -1
+        self._log.close()
+        try:
+            os.replace(tmp, self._path)
+        finally:
+            self._log = open(self._path, "ab")
+        self.log_bytes = os.path.getsize(self._path)
+        return self.log_bytes
+
+    def close(self) -> None:
+        self._log.close()
+
+
+class _NativeEngine:
+    """The C++ engine (native/store.cpp): index and values live outside the
+    Python heap; replay, torn-tail truncate, and compaction are native."""
+
+    def __init__(self, lib, path: str) -> None:
+        self._lib = lib
+        handle = lib.hs_store_open(path.encode(), 0)
+        if not handle:
+            raise OSError(f"hs_store_open failed for {path}")
+        self._handle = ctypes.c_void_p(handle)
+        self.log_bytes = os.path.getsize(path) if os.path.exists(path) else 0
+
+    def get(self, key: bytes) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        kbuf = (ctypes.c_uint8 * len(key)).from_buffer_copy(key)
+        n = self._lib.hs_store_read(
+            self._handle, kbuf, len(key), ctypes.byref(out)
+        )
+        if n < 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.hs_free(out)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        kbuf = (ctypes.c_uint8 * len(key)).from_buffer_copy(key)
+        vbuf = (ctypes.c_uint8 * max(1, len(value))).from_buffer_copy(
+            value or b"\x00"
+        )
+        rc = self._lib.hs_store_write(
+            self._handle, kbuf, len(key), vbuf, len(value)
+        )
+        if rc != 0:
+            raise OSError("hs_store_write failed")
+        self.log_bytes += 8 + len(key) + len(value)
+
+    def compact(self) -> int:
+        new_size = self._lib.hs_store_compact(self._handle)
+        if new_size >= 0:
+            self.log_bytes = new_size
+        return new_size
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.hs_store_close(self._handle)
+            self._handle = None
+
+
+def _make_engine(path: str | None):
+    if path is None:
+        return _MemEngine()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    from ..crypto import native_staging
+
+    lib = native_staging.get_lib()
+    if lib is not None and hasattr(lib, "hs_store_open"):
+        try:
+            return _NativeEngine(lib, path)
+        except OSError:
+            pass
+    return _PyLogEngine(path)
+
+
+class Store:
+    """Async KV store handle; cheap to share (all ops go through one queue)."""
+
+    def __init__(self, path: str | None = None) -> None:
+        self._engine = _make_engine(path)
+        # Back-compat: direct index access for in-memory/Python engines
+        # (tests introspect it); None for the native engine.
+        self._data = getattr(self._engine, "_data", None)
+        self._obligations: dict[bytes, deque[asyncio.Future]] = defaultdict(deque)
+        self._queue = channel()
+        self._path = path
+        self._compact_threshold = MIN_COMPACT_BYTES
+        self.compactions = 0
+        self._task = spawn(self._run(), name="store-writer")
+
+    @property
+    def engine_name(self) -> str:
+        return type(self._engine).__name__.strip("_")
+
+    async def _maybe_compact(self) -> None:
+        if self._engine.log_bytes <= self._compact_threshold:
+            return
+        # Off the event loop: a full live-set rewrite + fsync would stall
+        # consensus timers and network I/O. Store commands queue behind it
+        # (the actor serializes), the rest of the node keeps running.
+        new_size = await asyncio.to_thread(self._engine.compact)
+        self.compactions += 1
+        if new_size < 0:
+            # Failed (e.g. disk full): back off relative to the CURRENT log
+            # so every subsequent write doesn't re-attempt a full rewrite.
+            self._compact_threshold = max(
+                MIN_COMPACT_BYTES, 2 * self._engine.log_bytes
+            )
+            log.error("store compaction failed; next attempt at %s bytes",
+                      self._compact_threshold)
+            return
+        # Adaptive: if most of the log was live, double the threshold so
+        # steady-state growth doesn't trigger quadratic rewrites.
+        self._compact_threshold = max(MIN_COMPACT_BYTES, 2 * new_size)
 
     async def _run(self) -> None:
         while True:
             cmd, args, fut = await self._queue.get()
             if cmd == "write":
                 key, value = args
-                self._data[key] = value
-                if self._log is not None:
-                    self._log.write(struct.pack("<II", len(key), len(value)))
-                    self._log.write(key)
-                    self._log.write(value)
-                    self._log.flush()
+                try:
+                    self._engine.put(key, value)
+                    await self._maybe_compact()
+                except (OSError, ValueError) as e:
+                    # A failed write (disk full, failed compact) must neither
+                    # kill the writer actor (every later command would hang
+                    # forever) nor resolve the caller as if durable.
+                    log.error("store write failed: %r", e)
+                    if fut is not None and not fut.cancelled():
+                        fut.set_exception(e)
+                    continue
                 # Resolve pending notify_read obligations for this key
                 # (store/src/lib.rs:36-47).
                 for waiter in self._obligations.pop(key, ()):
@@ -73,12 +263,13 @@ class Store:
             elif cmd == "read":
                 (key,) = args
                 if not fut.cancelled():
-                    fut.set_result(self._data.get(key))
+                    fut.set_result(self._engine.get(key))
             elif cmd == "notify_read":
                 (key,) = args
-                if key in self._data:
+                value = self._engine.get(key)
+                if value is not None:
                     if not fut.cancelled():
-                        fut.set_result(self._data[key])
+                        fut.set_result(value)
                 else:
                     self._obligations[key].append(fut)
 
@@ -101,5 +292,4 @@ class Store:
 
     def close(self) -> None:
         self._task.cancel()
-        if self._log is not None:
-            self._log.close()
+        self._engine.close()

@@ -1,0 +1,146 @@
+// Sanitizer-targeted unit vectors for the native plane (run under
+// ASan/UBSan in CI). Correctness against the Python reference staging is
+// covered by tests/test_native_staging.py; this binary exercises the C ABI
+// surface: store replay / torn-tail truncate / compaction, and staging
+// output invariants (digit ranges, canonicality flag).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+extern "C" {
+void *hs_store_open(const char *path, int fsync_writes);
+int hs_store_write(void *s, const uint8_t *k, int64_t klen, const uint8_t *v,
+                   int64_t vlen);
+int64_t hs_store_read(void *s, const uint8_t *k, int64_t klen, uint8_t **out);
+int hs_store_contains(void *s, const uint8_t *k, int64_t klen);
+int64_t hs_store_len(void *s);
+int64_t hs_store_compact(void *s);
+void hs_store_close(void *s);
+void hs_free(void *p);
+int hs_stage_batch(const uint8_t *msgs, const int64_t *offsets,
+                   const uint8_t *keys, const uint8_t *sigs, int64_t n,
+                   float *a_y, float *a_sign, float *r_enc, float *s_digits,
+                   float *h_digits, uint8_t *s_ok);
+}
+
+static long file_size(const char *path) {
+  struct stat st;
+  return stat(path, &st) == 0 ? (long)st.st_size : -1;
+}
+
+static void test_store_roundtrip(const char *path) {
+  remove(path);
+  void *s = hs_store_open(path, 0);
+  assert(s);
+  assert(hs_store_write(s, (const uint8_t *)"key1", 4, (const uint8_t *)"val1",
+                        4) == 0);
+  assert(hs_store_write(s, (const uint8_t *)"key2", 4, (const uint8_t *)"",
+                        0) == 0);
+  uint8_t *out = nullptr;
+  assert(hs_store_read(s, (const uint8_t *)"key1", 4, &out) == 4);
+  assert(memcmp(out, "val1", 4) == 0);
+  hs_free(out);
+  assert(hs_store_read(s, (const uint8_t *)"nope", 4, &out) == -1);
+  assert(hs_store_contains(s, (const uint8_t *)"key2", 4) == 1);
+  assert(hs_store_len(s) == 2);
+  hs_store_close(s);
+
+  // replay
+  s = hs_store_open(path, 0);
+  assert(hs_store_len(s) == 2);
+  assert(hs_store_read(s, (const uint8_t *)"key2", 4, &out) == 0);
+  hs_free(out);
+  hs_store_close(s);
+  printf("store roundtrip: ok\n");
+}
+
+static void test_store_torn_tail(const char *path) {
+  remove(path);
+  void *s = hs_store_open(path, 0);
+  hs_store_write(s, (const uint8_t *)"a", 1, (const uint8_t *)"1", 1);
+  hs_store_write(s, (const uint8_t *)"b", 1, (const uint8_t *)"2", 1);
+  hs_store_close(s);
+  // tear one byte off the final record
+  long sz = file_size(path);
+  assert(sz > 0);
+  (void)truncate(path, sz - 1);
+
+  s = hs_store_open(path, 0);
+  assert(hs_store_contains(s, (const uint8_t *)"a", 1) == 1);
+  assert(hs_store_contains(s, (const uint8_t *)"b", 1) == 0);
+  // appended records after the truncated tail MUST survive the next replay
+  hs_store_write(s, (const uint8_t *)"c", 1, (const uint8_t *)"3", 1);
+  hs_store_close(s);
+  s = hs_store_open(path, 0);
+  assert(hs_store_contains(s, (const uint8_t *)"a", 1) == 1);
+  assert(hs_store_contains(s, (const uint8_t *)"c", 1) == 1);
+  hs_store_close(s);
+  printf("store torn tail: ok\n");
+}
+
+static void test_store_compact(const char *path) {
+  remove(path);
+  void *s = hs_store_open(path, 0);
+  std::vector<uint8_t> val(100, 0xAB);
+  for (int i = 0; i < 1000; i++) {
+    val[0] = (uint8_t)i;
+    hs_store_write(s, (const uint8_t *)"hot", 3, val.data(), val.size());
+  }
+  long before = file_size(path);
+  int64_t after = hs_store_compact(s);
+  assert(after > 0 && after < before / 10);
+  uint8_t *out = nullptr;
+  assert(hs_store_read(s, (const uint8_t *)"hot", 3, &out) == 100);
+  assert(out[0] == (uint8_t)231);  // 999 & 0xFF
+  hs_free(out);
+  // writes still work after compaction and survive replay
+  hs_store_write(s, (const uint8_t *)"post", 4, val.data(), 4);
+  hs_store_close(s);
+  s = hs_store_open(path, 0);
+  assert(hs_store_contains(s, (const uint8_t *)"post", 4) == 1);
+  assert(hs_store_len(s) == 2);
+  hs_store_close(s);
+  printf("store compact: ok (%ld -> %lld bytes)\n", before, (long long)after);
+}
+
+static void test_staging_invariants() {
+  const int64_t n = 2;
+  uint8_t msgs[64];
+  for (int i = 0; i < 64; i++) msgs[i] = (uint8_t)i;
+  int64_t offsets[3] = {0, 32, 64};
+  uint8_t keys[64], sigs[128];
+  for (int i = 0; i < 64; i++) keys[i] = (uint8_t)(i * 3 + 1);
+  for (int i = 0; i < 128; i++) sigs[i] = (uint8_t)(i * 5 + 7);
+  // item 1: s = 0xFF... (>= L): must be flagged non-canonical
+  memset(sigs + 96, 0xFF, 32);
+
+  std::vector<float> a_y(32 * n), a_sign(n), r_enc(32 * n), s_digits(64 * n),
+      h_digits(64 * n);
+  std::vector<uint8_t> s_ok(n);
+  int rc = hs_stage_batch(msgs, offsets, keys, sigs, n, a_y.data(),
+                          a_sign.data(), r_enc.data(), s_digits.data(),
+                          h_digits.data(), s_ok.data());
+  assert(rc == 0);
+  for (float d : s_digits) assert(d >= 0.0f && d < 16.0f);
+  for (float d : h_digits) assert(d >= 0.0f && d < 16.0f);
+  for (float v : a_y) assert(v >= 0.0f && v < 256.0f);
+  for (int64_t i = 0; i < n; i++) assert(a_sign[i] == 0.0f || a_sign[i] == 1.0f);
+  assert(s_ok[1] == 0);  // s >= L rejected
+  printf("staging invariants: ok\n");
+}
+
+int main() {
+  test_store_roundtrip("/tmp/hs_native_test_store.log");
+  test_store_torn_tail("/tmp/hs_native_test_torn.log");
+  test_store_compact("/tmp/hs_native_test_compact.log");
+  test_staging_invariants();
+  printf("ALL NATIVE TESTS PASSED\n");
+  return 0;
+}

@@ -66,14 +66,14 @@ def test_size_flush_before_deadline(keys, run_async):
     run_async(body())
 
 
-def test_verify_many_spanning_flushes(keys, run_async):
+def test_group_larger_than_max_batch(keys, run_async):
     async def body():
         svc = BatchVerificationService(
             CpuBackend(), max_batch=3, max_delay=0.005
         )
         digest = Digest.of(b"qc")
         pairs = [(pk, Signature.new(digest, sk)) for pk, sk in keys]
-        mask = await svc.verify_many([digest.data] * 4, pairs)
+        mask = await svc.verify_group([digest.data] * 4, pairs)
         assert mask == [True] * 4
 
     run_async(body())

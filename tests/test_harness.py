@@ -1,0 +1,104 @@
+"""Benchmark-harness unit tests: aggregation family files and the three
+reference plot families (reference aggregate.py:75-174, plot.py:56-164),
+driven from synthetic result files in the reference's result format."""
+
+import os
+
+import pytest
+
+from benchmark.aggregate import aggregate_results, parse_result_file
+
+
+RESULT_TEMPLATE = """\
+-----------------------------------------
+ SUMMARY:
+-----------------------------------------
+ + CONFIG:
+ Faults: {faults} nodes
+ Committee size: {nodes} nodes
+ Input rate: {rate:,} tx/s
+ Transaction size: {tx} B
+ Execution time: 20 s
+
+ + RESULTS:
+ Consensus TPS: {ctps:,} tx/s
+ Consensus latency: {clat} ms
+
+ End-to-end TPS: {etps:,} tx/s
+ End-to-end latency: {elat} ms
+-----------------------------------------
+"""
+
+
+def write_result(directory, nodes, rate, faults, run, etps, elat):
+    path = os.path.join(
+        directory, f"bench-{nodes}-{rate}-512-{faults}-{run}.txt"
+    )
+    with open(path, "w") as f:
+        f.write(
+            RESULT_TEMPLATE.format(
+                faults=faults,
+                nodes=nodes,
+                rate=rate,
+                tx=512,
+                ctps=etps + 10,
+                clat=max(1, elat - 5),
+                etps=etps,
+                elat=elat,
+            )
+        )
+    return path
+
+
+@pytest.fixture
+def results_dir(tmp_path):
+    d = str(tmp_path)
+    # 4-node sweep: healthy, then saturated at 20k
+    write_result(d, 4, 1_000, 0, 0, 950, 30)
+    write_result(d, 4, 1_000, 0, 1, 970, 34)  # repeat run
+    write_result(d, 4, 10_000, 0, 0, 9_800, 60)
+    write_result(d, 4, 20_000, 0, 0, 12_000, 9_000)  # saturated
+    # 10-node point and a faulty run
+    write_result(d, 10, 10_000, 0, 0, 9_500, 120)
+    write_result(d, 4, 1_000, 1, 0, 700, 800)
+    return d
+
+
+def test_parse_result_file(results_dir):
+    r = parse_result_file(
+        os.path.join(results_dir, "bench-4-1000-512-0-0.txt")
+    )
+    assert r["nodes"] == 4 and r["rate"] == 1_000
+    assert r["e2e_tps"] == 950 and r["e2e_latency"] == 30
+
+
+def test_aggregate_means_and_family_files(results_dir):
+    agg = aggregate_results(results_dir)
+    # repeated runs averaged with stdev
+    key = (4.0, 0.0, 512.0, 1_000.0)
+    assert agg[key]["e2e_tps"]["runs"] == 2
+    assert agg[key]["e2e_tps"]["mean"] == 960
+    assert agg[key]["e2e_tps"]["stdev"] > 0
+    for name in ("aggregated.txt", "agg-latency.txt", "agg-robustness.txt", "agg-tps.txt"):
+        assert os.path.exists(os.path.join(results_dir, name)), name
+    tps = open(os.path.join(results_dir, "agg-tps.txt")).read()
+    # under a 2s SLO the saturated 20k point must NOT win for 4 nodes
+    assert "max_latency_ms=2000 nodes=4 best_tps=9800" in tps
+    # faulty runs are excluded from the SLO family
+    assert "best_tps=700" not in tps
+
+
+def test_plot_families(results_dir):
+    pytest.importorskip("matplotlib")
+    from benchmark.plot import plot_results
+
+    outs = plot_results(results_dir)
+    assert len(outs) == 3
+    for o in outs:
+        assert os.path.getsize(o) > 1_000  # a real PDF, not an empty file
+    names = {os.path.basename(o) for o in outs}
+    assert names == {
+        "latency-vs-throughput.pdf",
+        "tps-vs-committee.pdf",
+        "robustness.pdf",
+    }

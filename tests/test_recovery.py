@@ -1,0 +1,199 @@
+"""Crash-recovery: the persisted safety state (consensus/core.py
+_load_safety_state / _store_safety_state) closes the double-vote-after-crash
+gap the reference acknowledges (consensus/src/core.rs:121, upstream issue
+#15). These tests fail if _load_safety_state is deleted or stops being
+called: a restarted node would happily re-vote the round it already voted."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hotstuff_tpu.consensus import Block, Committee, Parameters, Vote
+from hotstuff_tpu.consensus.core import Core
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.mempool_driver import MempoolDriver
+from hotstuff_tpu.consensus.messages import decode_consensus_message
+from hotstuff_tpu.consensus.synchronizer import Synchronizer
+from hotstuff_tpu.crypto import SignatureService
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.actors import channel, spawn
+from tests.common import MockMempool, chain, committee, keys
+
+
+def make_core_on_store(name_index: int, cmt: Committee, store: Store):
+    pk, sk = keys()[name_index]
+    sig_service = SignatureService(sk)
+    mock = MockMempool()
+    mock.start()
+    core_channel = channel()
+    network_tx = channel()
+    commit_channel = channel()
+    params = Parameters(timeout_delay=60_000)  # pacemaker out of the way
+    sync = Synchronizer(
+        pk, cmt, store, network_tx, core_channel, params.sync_retry_delay
+    )
+    core = Core(
+        pk,
+        cmt,
+        params,
+        sig_service,
+        store,
+        LeaderElector(cmt),
+        MempoolDriver(mock.channel),
+        sync,
+        core_channel,
+        network_tx,
+        commit_channel,
+    )
+    return core, core_channel, network_tx
+
+
+def test_restart_does_not_double_vote_and_rejoins(run_async, base_port, tmp_path):
+    """Vote on b1, crash, restart from the same store: the same proposal must
+    NOT get a second vote (its signature already left the node — re-signing
+    the same round after restart is exactly reference issue #15), but a
+    round-2 proposal must (the node rejoins)."""
+
+    async def body():
+        cmt = committee(base_port)
+        b1, b2, _ = chain(3, cmt)
+        elector = LeaderElector(cmt)
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (b1.author, elector.get_leader(2), b2.author, elector.get_leader(3))
+        )
+        store_path = str(tmp_path / "store.log")
+
+        store = Store(store_path)
+        core, core_channel, network_tx = make_core_on_store(idx, cmt, store)
+        task = spawn(core.run())
+        await core_channel.put(b1)
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        vote = decode_consensus_message(msg.data)
+        assert isinstance(vote, Vote) and vote.round == 1
+
+        # CRASH: kill the actor without any clean shutdown, reopen the store
+        # from disk exactly as a restarted process would.
+        task.cancel()
+        store.close()
+        store2 = Store(store_path)
+        core2, core_channel2, network_tx2 = make_core_on_store(idx, cmt, store2)
+        assert core2.last_voted_round == 0  # fresh instance, pre-recovery
+        spawn(core2.run())
+        await asyncio.sleep(0.1)
+        # Recovery must have restored the persisted safety state.
+        assert core2.last_voted_round == 1, (
+            "restart lost last_voted_round: the node would double-vote"
+        )
+
+        # The round-1 proposal again: no second vote may be emitted.
+        await core_channel2.put(b1)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(network_tx2.get(), 0.5)
+
+        # But the chain moving on (round 2) gets a vote: the node rejoined.
+        await core_channel2.put(b2)
+        while True:
+            msg = await asyncio.wait_for(network_tx2.get(), 10)
+            out = decode_consensus_message(msg.data)
+            if isinstance(out, Vote):
+                break
+        assert out.round == 2 and out.hash == b2.digest()
+
+    run_async(body())
+
+
+def _wait_for_log(path: str, needle: str, timeout: float, offset: int = 0) -> int:
+    """Poll `path` until `needle` appears at/after byte `offset`; returns the
+    end offset of the match."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(path) as f:
+                f.seek(offset)
+                content = f.read()
+            i = content.find(needle)
+            if i >= 0:
+                return offset + i + len(needle)
+        except OSError:
+            pass
+        time.sleep(0.25)
+    raise AssertionError(f"{needle!r} never appeared in {path}")
+
+
+@pytest.mark.slow
+def test_process_kill_restart_rejoins(tmp_path, base_port):
+    """Full-process version: SIGKILL a running node mid-protocol, restart it
+    on the same store, and require (a) safety-state recovery in its log and
+    (b) commits resuming after the restart."""
+    from hotstuff_tpu.node.config import Secret
+    from benchmark.config import LocalCommittee
+    from benchmark.commands import CommandMaker
+
+    n = 4
+    cwd = str(tmp_path)
+    key_files = [os.path.join(cwd, f"node-{i}.json") for i in range(n)]
+    names = []
+    for f in key_files:
+        s = Secret.new()
+        s.write(f)
+        names.append(s.name.encode_base64())
+    committee_file = os.path.join(cwd, "committee.json")
+    LocalCommittee(names, base_port).write(committee_file)
+    params_file = os.path.join(cwd, "parameters.json")
+    import json
+
+    with open(params_file, "w") as f:
+        json.dump(
+            {
+                "consensus": {"timeout_delay": 2_000, "min_block_delay": 50},
+                "mempool": {"min_block_delay": 50},
+            },
+            f,
+        )
+
+    procs = {}
+    logs = {}
+
+    def boot(i: int, fresh_log: bool = True) -> None:
+        cmd = CommandMaker.run_node(
+            key_files[i],
+            committee_file,
+            os.path.join(cwd, f"db-{i}", "log"),
+            params_file,
+        )
+        logs[i] = os.path.join(cwd, f"node-{i}.log")
+        out = open(logs[i], "w" if fresh_log else "a")
+        procs[i] = subprocess.Popen(
+            cmd.split(), stdout=out, stderr=subprocess.STDOUT, cwd=os.getcwd()
+        )
+
+    try:
+        for i in range(n):
+            boot(i)
+        victim = n - 1
+        # Wait until the victim has committed (it voted by then).
+        _wait_for_log(logs[victim], "Committed B", 90)
+        procs[victim].kill()  # SIGKILL: no atexit, no flush, a real crash
+        procs[victim].wait(10)
+        kill_offset = os.path.getsize(logs[victim])
+
+        boot(victim, fresh_log=False)
+        off = _wait_for_log(logs[victim], "Recovered safety state", 90, kill_offset)
+        # Commits must RESUME after restart (the node rejoined the committee).
+        _wait_for_log(logs[victim], "Committed B", 90, off)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+
+    # The restarted node never voted twice in one round: every round in its
+    # post-restart log that it voted is strictly greater than any pre-kill
+    # voted round would require vote introspection; the in-process test above
+    # asserts the double-vote property directly.

@@ -1,0 +1,80 @@
+"""Crypto sidecar: RemoteBackend <-> serve() round-trip and fallback."""
+
+import asyncio
+import random
+
+import pytest
+
+from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+from hotstuff_tpu.crypto.backend import CpuBackend
+from hotstuff_tpu.crypto.remote import RemoteBackend, serve
+
+
+@pytest.fixture
+def triples():
+    rng = random.Random(3)
+    out = []
+    for i in range(8):
+        pk, sk = generate_keypair(rng)
+        d = Digest.of(b"msg-%d" % i)
+        out.append((d.data, pk, Signature.new(d, sk)))
+    return out
+
+
+def test_round_trip_and_mask(triples, run_async, base_port):
+    async def body():
+        server = asyncio.create_task(
+            serve(("127.0.0.1", base_port), CpuBackend(), max_delay=0.001)
+        )
+        await asyncio.sleep(0.2)
+        backend = RemoteBackend(("127.0.0.1", base_port), crossover=1)
+        msgs = [m for m, _, _ in triples]
+        keys = [k for _, k, _ in triples]
+        sigs = [s for _, _, s in triples]
+        mask = await asyncio.to_thread(
+            backend.verify_batch_mask, msgs, keys, sigs
+        )
+        assert mask == [True] * len(triples)
+        # corrupt one signature: only that item flips
+        bad_sigs = list(sigs)
+        bad_sigs[3] = sigs[4]
+        mask2 = await asyncio.to_thread(
+            backend.verify_batch_mask, msgs, keys, bad_sigs
+        )
+        assert mask2[3] is False
+        assert [m for i, m in enumerate(mask2) if i != 3] == [True] * 7
+        assert backend.stats["remote_batches"] == 2
+        # two sequential requests reuse one connection
+        server.cancel()
+
+    run_async(body())
+
+
+def test_small_batches_stay_local(triples, run_async, base_port):
+    async def body():
+        backend = RemoteBackend(("127.0.0.1", base_port + 7), crossover=64)
+        m, k, s = triples[0]
+        # below crossover: CPU path, no connection attempted (port is dead)
+        mask = await asyncio.to_thread(backend.verify_batch_mask, [m], [k], [s])
+        assert mask == [True]
+        assert backend.stats["cpu_batches"] == 1
+        assert backend.stats["remote_batches"] == 0
+
+    run_async(body())
+
+
+def test_unreachable_sidecar_falls_back_to_cpu(triples, run_async, base_port):
+    async def body():
+        backend = RemoteBackend(
+            ("127.0.0.1", base_port + 8), crossover=1, timeout=0.5
+        )
+        msgs = [m for m, _, _ in triples]
+        keys = [k for _, k, _ in triples]
+        sigs = [s for _, _, s in triples]
+        mask = await asyncio.to_thread(
+            backend.verify_batch_mask, msgs, keys, sigs
+        )
+        assert mask == [True] * len(triples)
+        assert backend.stats["cpu_batches"] == 1
+
+    run_async(body())
