@@ -251,6 +251,39 @@ def _verify_kernel_w4(a_y, a_sign, r_enc, s_digits, h_digits):
     return valid & jnp.all(enc == r_enc, axis=0)
 
 
+# --- packed (u8) wire format ----------------------------------------------
+#
+# The f32 kernel arguments are 772 B/signature (a_y, r_enc 128 B each;
+# s/h_digits 256 B each) — 6.3 MB at batch 8192, which dominates end-to-end
+# time when host<->device bandwidth is scarce (e.g. a tunneled chip). The
+# packed path ships the raw 32-byte u8 rows (a, R, s, h = 128 B/signature,
+# a 6x reduction) and unpacks to limbs/digits on device (a handful of VPU
+# byte ops, free next to the 253-step ladder).
+
+
+def _device_nibbles(b: jnp.ndarray) -> jnp.ndarray:
+    """(32, B) u8 -> (64, B) f32 of 4-bit little-endian digits (row 2k = low
+    nibble of byte k), matching the host-side `_nibbles` layout."""
+    lo = (b & 0x0F).astype(jnp.float32)
+    hi = (b >> 4).astype(jnp.float32)
+    return jnp.stack((lo, hi), axis=1).reshape(2 * b.shape[0], b.shape[1])
+
+
+def unpack_packed_inputs(a_bytes, r_bytes, s_bytes, h_bytes):
+    """u8 (32, B) wire arrays -> the standard f32 kernel arguments."""
+    top = a_bytes[31]
+    a_y = a_bytes.astype(jnp.float32).at[31].set(
+        (top & 0x7F).astype(jnp.float32)
+    )
+    a_sign = (top >> 7).astype(jnp.float32)
+    r_enc = r_bytes.astype(jnp.float32)
+    return a_y, a_sign, r_enc, _device_nibbles(s_bytes), _device_nibbles(h_bytes)
+
+
+def _verify_kernel_w4_packed(a_bytes, r_bytes, s_bytes, h_bytes):
+    return _verify_kernel_w4(*unpack_packed_inputs(a_bytes, r_bytes, s_bytes, h_bytes))
+
+
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     """Compressed y (+ sign of x) -> affine (x, -x, y) + validity mask.
 
@@ -321,6 +354,7 @@ def _verify_kernel(a_y, a_sign, r_enc, s_bits, h_bits):
 
 _verify_jit = jax.jit(_verify_kernel)
 _verify_w4_jit = jax.jit(_verify_kernel_w4)
+_verify_w4p_jit = jax.jit(_verify_kernel_w4_packed)
 
 
 # ---------------------------------------------------------------------------
