@@ -41,9 +41,17 @@ class TpuBackend(CryptoBackend):
                 mesh=mesh, max_bucket=max_bucket
             )
         else:
+            import jax
+
             from ..ops.ed25519 import Ed25519TpuVerifier
 
-            self._verifier = Ed25519TpuVerifier(max_bucket=max_bucket)
+            # pallas ladder on a real accelerator; the jnp w4 kernel on the
+            # CPU interpreter (pallas has no CPU lowering). Packed wire
+            # format + threaded upload pipeline either way.
+            kernel = "w4" if jax.default_backend() == "cpu" else "pallas"
+            self._verifier = Ed25519TpuVerifier(
+                max_bucket=max_bucket, kernel=kernel
+            )
         self._cpu = CpuBackend()
         self.crossover = crossover
         self._lock = threading.Lock()

@@ -32,6 +32,8 @@ and doubling inputs, so the ladder needs no special cases.
 from __future__ import annotations
 
 import hashlib
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 import jax
@@ -284,6 +286,15 @@ def _verify_kernel_w4_packed(a_bytes, r_bytes, s_bytes, h_bytes):
     return _verify_kernel_w4(*unpack_packed_inputs(a_bytes, r_bytes, s_bytes, h_bytes))
 
 
+def split_packed128(packed: jnp.ndarray) -> tuple:
+    """(128, B) u8 wire array -> (a, r, s, h) (32, B) row groups."""
+    return packed[0:32], packed[32:64], packed[64:96], packed[96:128]
+
+
+def _verify_kernel_w4_packed128(packed):
+    return _verify_kernel_w4(*unpack_packed_inputs(*split_packed128(packed)))
+
+
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
     """Compressed y (+ sign of x) -> affine (x, -x, y) + validity mask.
 
@@ -355,6 +366,7 @@ def _verify_kernel(a_y, a_sign, r_enc, s_bits, h_bits):
 _verify_jit = jax.jit(_verify_kernel)
 _verify_w4_jit = jax.jit(_verify_kernel_w4)
 _verify_w4p_jit = jax.jit(_verify_kernel_w4_packed)
+_verify_w4p128_jit = jax.jit(_verify_kernel_w4_packed128)
 
 
 # ---------------------------------------------------------------------------
@@ -392,15 +404,7 @@ def prepare_batch(
     a_sign = (a[:, 31] >> 7).astype(np.float32)
     r_enc = r.astype(np.float32).T.copy()
 
-    s_ok = np.empty(n, bool)
-    h_bytes = np.empty((n, 32), np.uint8)
-    for i in range(n):
-        s_ok[i] = int.from_bytes(s[i].tobytes(), "little") < L_ORDER
-        hd = hashlib.sha512(
-            r[i].tobytes() + a[i].tobytes() + messages[i]
-        ).digest()
-        h = int.from_bytes(hd, "little") % L_ORDER
-        h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    s_ok, h_bytes = _stage_scalars(messages, a, r, s)
 
     staged = dict(
         a_y=a_y,
@@ -416,6 +420,48 @@ def prepare_batch(
         staged["s_bits"] = sb.astype(np.float32)
         staged["h_bits"] = hb.astype(np.float32)
     return staged
+
+
+def prepare_batch_packed(
+    messages: Sequence[bytes],
+    keys: Sequence[bytes],
+    signatures: Sequence[bytes],
+    allow_native: bool = True,
+) -> dict:
+    """Packed (wire-format) staging: dict(packed=(128, B) u8, s_ok=(B,) bool).
+
+    Rows 0-31 = A, 32-63 = R, 64-95 = S, 96-127 = h (SHA-512(R||A||M) mod L).
+    128 B/signature on the host->device link — 6x less than the f32 form of
+    `prepare_batch`; the kernel unpacks on device (`split_packed128` +
+    `unpack_packed_inputs`, a handful of VPU byte ops next to the ladder).
+    """
+    if allow_native:
+        from ..crypto import native_staging
+
+        staged = native_staging.stage_batch_packed(messages, keys, signatures)
+        if staged is not None:
+            return staged
+    n = len(messages)
+    a = np.frombuffer(b"".join(keys), np.uint8).reshape(n, 32)
+    sig = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
+    r, s = sig[:, :32], sig[:, 32:]
+    s_ok, h_bytes = _stage_scalars(messages, a, r, s)
+    packed = np.ascontiguousarray(np.vstack([a.T, r.T, s.T, h_bytes.T]))
+    return dict(packed=packed, s_ok=s_ok)
+
+
+def _stage_scalars(messages, a, r, s) -> tuple[np.ndarray, np.ndarray]:
+    """Python staging of the per-item scalar work shared by both wire
+    formats: the s<L canonicality mask and h = SHA-512(R||A||M) mod L."""
+    n = len(messages)
+    s_ok = np.empty(n, bool)
+    h_bytes = np.empty((n, 32), np.uint8)
+    for i in range(n):
+        s_ok[i] = int.from_bytes(s[i].tobytes(), "little") < L_ORDER
+        hd = hashlib.sha512(r[i].tobytes() + a[i].tobytes() + messages[i]).digest()
+        h = int.from_bytes(hd, "little") % L_ORDER
+        h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), np.uint8)
+    return s_ok, h_bytes
 
 
 def _nibbles(b: np.ndarray) -> np.ndarray:
@@ -436,12 +482,49 @@ def _pad(arr: np.ndarray, width: int) -> np.ndarray:
     return np.pad(arr, cfg)
 
 
+_UPLOADER: "ThreadPoolExecutor | None" = None
+_UPLOADER_LOCK = threading.Lock()
+
+
+def _uploader() -> "ThreadPoolExecutor":
+    """One shared background thread for host->device uploads + dispatches.
+
+    Measured on a tunneled chip: issuing device_put from the main thread
+    serializes transfers with kernel execution (one RPC stream), while a
+    second thread overlaps them (~1.5x e2e). A single worker keeps chunk
+    order (FIFO executor queue) and avoids RPC contention from parallel
+    transfers, which measurably degrades tunnel bandwidth.
+    """
+    global _UPLOADER
+    with _UPLOADER_LOCK:
+        if _UPLOADER is None:
+            _UPLOADER = ThreadPoolExecutor(1, thread_name_prefix="tpu-upload")
+        return _UPLOADER
+
+
+def _upload_dispatch(fn, padded: np.ndarray):
+    """Runs on the uploader thread: ship one packed chunk, dispatch the
+    kernel (async), return the device mask handle."""
+    import jax as _jax
+
+    return fn(_jax.device_put(padded))
+
+
 class Ed25519TpuVerifier:
-    """Bucketed dispatcher for the jitted kernel.
+    """Bucketed, pipelined dispatcher for the jitted kernel.
 
     Batches are padded up to power-of-two lane widths (>= 128 so the lane
     dimension is full) to bound the number of XLA compilations; oversize
-    batches are chunked at `max_bucket`.
+    batches are split at `chunk` and PIPELINED: each chunk ships as a packed
+    (128, W) u8 wire array (`prepare_batch_packed`) and is uploaded +
+    dispatched from a background thread, so host staging of chunk k+1
+    overlaps the transfer of chunk k and the device compute of chunk k-1;
+    all chunk masks are fetched in ONE device->host readback (per-transfer
+    latency is paid once, not per chunk — decisive over low-bandwidth/
+    tunneled links).
+
+    `packed=False` restores the f32 argument path (used by the sharded
+    mesh verifier and the legacy bit-ladder kernel).
     """
 
     def __init__(
@@ -449,6 +532,8 @@ class Ed25519TpuVerifier:
         min_bucket: int = 128,
         max_bucket: int = 8192,
         kernel: str = "w4",
+        packed: bool | None = None,
+        chunk: int | None = None,
     ):
         self.kernel = kernel
         if kernel == "pallas":
@@ -459,12 +544,21 @@ class Ed25519TpuVerifier:
             max_bucket = max(BLOCK, max_bucket // BLOCK * BLOCK)
         self.min_bucket = min_bucket
         self.max_bucket = max_bucket
+        self.packed = packed if packed is not None else kernel != "bits"
+        self.chunk = min(chunk or 4096, max_bucket)
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
         while b < n:
             b *= 2
         return min(b, self.max_bucket)
+
+    def _packed_fn(self):
+        if self.kernel == "pallas":
+            from . import pallas_ladder
+
+            return pallas_ladder._verify_pallas_p128_jit
+        return _verify_w4p128_jit
 
     def verify_batch_mask(
         self,
@@ -473,12 +567,40 @@ class Ed25519TpuVerifier:
         signatures: Sequence[bytes],
     ) -> np.ndarray:
         n = len(messages)
-        out = np.empty(n, bool)
-        for lo in range(0, n, self.max_bucket):
-            hi = min(lo + self.max_bucket, n)
-            out[lo:hi] = self._run_chunk(
+        if n == 0:
+            return np.empty(0, bool)
+        if not self.packed:
+            out = np.empty(n, bool)
+            for lo in range(0, n, self.max_bucket):
+                hi = min(lo + self.max_bucket, n)
+                out[lo:hi] = self._run_chunk(
+                    messages[lo:hi], keys[lo:hi], signatures[lo:hi]
+                )
+            return out
+        fn = self._packed_fn()
+        up = _uploader()
+        futs, oks, spans = [], [], []
+        for lo in range(0, n, self.chunk):
+            hi = min(lo + self.chunk, n)
+            staged = prepare_batch_packed(
                 messages[lo:hi], keys[lo:hi], signatures[lo:hi]
             )
+            width = self._bucket(hi - lo)
+            futs.append(
+                up.submit(_upload_dispatch, fn, _pad(staged["packed"], width))
+            )
+            oks.append(staged["s_ok"])
+            spans.append((lo, hi, width))
+        masks = [f.result() for f in futs]
+        out = np.empty(n, bool)
+        if len(masks) == 1:
+            full = np.asarray(masks[0])
+        else:
+            full = np.asarray(jnp.concatenate(masks))
+        off = 0
+        for (lo, hi, width), ok in zip(spans, oks):
+            out[lo:hi] = full[off : off + hi - lo] & ok
+            off += width
         return out
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:

@@ -159,3 +159,13 @@ def _verify_kernel_pallas(a_y, a_sign, r_enc, s_digits, h_digits):
 
 
 _verify_pallas_jit = jax.jit(_verify_kernel_pallas)
+
+
+def _verify_kernel_pallas_packed128(packed):
+    """(128, B) u8 wire array (see ed.prepare_batch_packed) -> (B,) bool."""
+    return _verify_kernel_pallas(
+        *ed.unpack_packed_inputs(*ed.split_packed128(packed))
+    )
+
+
+_verify_pallas_p128_jit = jax.jit(_verify_kernel_pallas_packed128)

@@ -133,6 +133,7 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)
+        self.packed = False  # sharded path stages f32 args via _run_chunk
         self.mesh = mesh or default_mesh()
         self._ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         # per-device shard keeps full lanes (and pallas BLOCK alignment)

@@ -230,6 +230,47 @@ extern "C" int hs_stage_batch(
   return 0;
 }
 
+// Packed wire-format staging: one (128, n) u8 row-major array
+// (rows 0-31 = A, 32-63 = R, 64-95 = S, 96-127 = h = SHA-512(R||A||M) mod L)
+// shipped to the device as-is and unpacked there (ops/ed25519
+// unpack_packed_inputs). 128 B/signature vs 772 B for the f32 arguments —
+// the transfer reduction that makes the pipelined end-to-end path
+// device-bound instead of transfer-bound on low-bandwidth host<->TPU links.
+extern "C" int hs_stage_batch_packed(
+    const uint8_t *msgs,        // concatenated message bytes
+    const int64_t *msg_offsets, // n+1 offsets into msgs
+    const uint8_t *keys,        // n * 32
+    const uint8_t *sigs,        // n * 64
+    int64_t n,
+    uint8_t *packed, // (128, n) row-major
+    uint8_t *s_ok    // (n,)
+) {
+  uint8_t *rows_a = packed;
+  uint8_t *rows_r = packed + 32 * n;
+  uint8_t *rows_s = packed + 64 * n;
+  uint8_t *rows_h = packed + 96 * n;
+  for (int64_t b = 0; b < n; b++) {
+    const uint8_t *A = keys + 32 * b;
+    const uint8_t *R = sigs + 64 * b;
+    const uint8_t *S = sigs + 64 * b + 32;
+    for (int i = 0; i < 32; i++) {
+      rows_a[(int64_t)i * n + b] = A[i];
+      rows_r[(int64_t)i * n + b] = R[i];
+      rows_s[(int64_t)i * n + b] = S[i];
+    }
+    s_ok[b] = (uint8_t)lt_l_bytes(S);
+
+    const uint8_t *parts[3] = {R, A, msgs + msg_offsets[b]};
+    const size_t lens[3] = {32, 32,
+                            (size_t)(msg_offsets[b + 1] - msg_offsets[b])};
+    uint8_t hd[64], hred[32];
+    sha512(parts, lens, 3, hd);
+    reduce_mod_l(hd, hred);
+    for (int i = 0; i < 32; i++) rows_h[(int64_t)i * n + b] = hred[i];
+  }
+  return 0;
+}
+
 // Standalone helpers (exported for tests)
 extern "C" void hs_sha512(const uint8_t *data, int64_t len, uint8_t out[64]) {
   const uint8_t *parts[1] = {data};
