@@ -91,7 +91,9 @@ class LocalBench:
                     join("logs", "sidecar.log"),
                 )
                 sidecar_proc = self._procs[-1]
-                deadline = time.monotonic() + 180  # first jit compile is slow
+                # JAX/TPU init + per-bucket warmup (even cache-hits pay
+                # ~30 s device program load over a tunneled chip)
+                deadline = time.monotonic() + 480
                 while time.monotonic() < deadline:
                     if sidecar_proc.poll() is not None:
                         raise BenchError(

@@ -22,6 +22,12 @@ def main() -> None:
     p.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("--benchmark-workload", action="store_true",
                    help="enable the fork's synthetic batch-verification workload")
+    p.add_argument("--mempool-payload-size", type=int, default=None,
+                   help="override mempool max_payload_size (bytes); bigger "
+                   "payloads = bigger verification batches (reference remote "
+                   "config uses 500 kB, fabfile.py:107-120)")
+    p.add_argument("--timeout-delay", type=int, default=None,
+                   help="override consensus timeout_delay (ms)")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args()
 
@@ -36,6 +42,10 @@ def main() -> None:
     node_params = {k: dict(v) for k, v in LOCAL_NODE_PARAMS.items()}
     if args.benchmark_workload:
         node_params["mempool"]["benchmark_mode"] = True
+    if args.mempool_payload_size is not None:
+        node_params["mempool"]["max_payload_size"] = args.mempool_payload_size
+    if args.timeout_delay is not None:
+        node_params["consensus"]["timeout_delay"] = args.timeout_delay
     parser = LocalBench(bench_params, node_params).run(debug=args.debug)
     print(parser.result())
 

@@ -55,12 +55,20 @@ class BatchVerificationService:
         backend: CryptoBackend | None = None,
         max_batch: int = 8192,
         max_delay: float = 0.002,
+        max_concurrent_dispatches: int = 4,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
         self.max_delay = max_delay
         self._queue: asyncio.Queue[_Group] = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        # Flushes dispatch CONCURRENTLY (bounded): an urgent 3-signature QC
+        # check must not wait out a multi-thousand-signature workload batch
+        # already in flight on the device (backends route small batches to
+        # the CPU fast path, so the urgent flush completes in microseconds
+        # while the big dispatch is still on the wire).
+        self._dispatch_sem = asyncio.Semaphore(max_concurrent_dispatches)
+        self._dispatches: set[asyncio.Task] = set()
         self.stats = {
             "flushes": 0,
             "size_flushes": 0,
@@ -142,6 +150,21 @@ class BatchVerificationService:
                 total += len(g)
                 urgent |= g.urgent
 
+            # Urgent flushes bypass the dispatch bound: when every slot is
+            # held by a large workload batch in flight, a 3-signature QC
+            # check must still dispatch immediately (backends send small
+            # batches down the CPU fast path, so unbounded urgent dispatches
+            # are bounded in practice by the consensus message rate).
+            if not urgent:
+                await self._dispatch_sem.acquire()
+            task = asyncio.get_running_loop().create_task(
+                self._dispatch(groups, total, urgent), name="verify-dispatch"
+            )
+            self._dispatches.add(task)
+            task.add_done_callback(self._dispatches.discard)
+
+    async def _dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
+        try:
             msgs = [m for g in groups for m in g.messages]
             keys = [k for g in groups for k in g.keys]
             sigs = [s for g in groups for s in g.signatures]
@@ -154,7 +177,7 @@ class BatchVerificationService:
                 for g in groups:
                     if not g.future.done():
                         g.future.set_exception(exc)
-                continue
+                return
             self.stats["flushes"] += 1
             self.stats["size_flushes"] += total >= self.max_batch
             self.stats["urgent_flushes"] += urgent
@@ -165,3 +188,6 @@ class BatchVerificationService:
                 if not g.future.cancelled():
                     g.future.set_result([bool(b) for b in mask[lo:hi]])
                 lo = hi
+        finally:
+            if not urgent:
+                self._dispatch_sem.release()

@@ -60,22 +60,44 @@ class RemoteBackend(CryptoBackend):
     name = "remote"
 
     def __init__(
-        self, addr: tuple[str, int], crossover: int = 64, timeout: float = 30.0
+        self,
+        addr: tuple[str, int],
+        crossover: int = 64,
+        timeout: float = 30.0,
+        pool_size: int = 3,
     ):
         self.addr = addr
         self.crossover = crossover
         self.timeout = timeout
         self._cpu = CpuBackend()
-        self._sock: socket.socket | None = None
-        self._lock = threading.Lock()
+        # Small connection pool: concurrent service dispatches each borrow a
+        # socket, so a second batch streams into the sidecar while the first
+        # is on the device (one socket would serialize the round trips).
+        self._pool: list[socket.socket] = []
+        self._pool_lock = threading.Lock()
+        self._pool_sem = threading.BoundedSemaphore(pool_size)
         self.stats = {"remote_batches": 0, "remote_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
-    def _connect(self) -> socket.socket:
-        if self._sock is None:
-            s = socket.create_connection(self.addr, timeout=self.timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._sock = s
-        return self._sock
+    def _borrow(self) -> socket.socket:
+        with self._pool_lock:
+            if self._pool:
+                return self._pool.pop()
+        s = socket.create_connection(self.addr, timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _give_back(self, sock: socket.socket) -> None:
+        with self._pool_lock:
+            self._pool.append(sock)
+
+    def _flush_pool(self) -> None:
+        with self._pool_lock:
+            stale, self._pool = self._pool, []
+        for s in stale:
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def _recv_exact(self, sock: socket.socket, n: int) -> bytes:
         buf = bytearray()
@@ -100,25 +122,38 @@ class RemoteBackend(CryptoBackend):
             self.stats["cpu_sigs"] += n
             return self._cpu.verify_batch_mask(messages, keys, signatures)
         payload = _encode_request(messages, keys, signatures)
-        with self._lock:
+        with self._pool_sem:  # bound concurrent round-trips to the pool size
             for attempt in (0, 1):
+                sock = None
                 try:
-                    sock = self._connect()
+                    if attempt == 0:
+                        sock = self._borrow()
+                    else:
+                        # Pooled sockets may ALL be stale (sidecar restart);
+                        # the final attempt must dial fresh, and the rest of
+                        # the suspect pool is dropped below.
+                        self._flush_pool()
+                        sock = socket.create_connection(
+                            self.addr, timeout=self.timeout
+                        )
+                        sock.setsockopt(
+                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                        )
                     sock.sendall(payload)
                     (count,) = struct.unpack("<I", self._recv_exact(sock, 4))
                     if count != n:
                         raise ConnectionError("sidecar count mismatch")
                     mask = self._recv_exact(sock, n)
+                    self._give_back(sock)
                     self.stats["remote_batches"] += 1
                     self.stats["remote_sigs"] += n
                     return [b != 0 for b in mask]
                 except (OSError, ConnectionError) as e:
-                    if self._sock is not None:
+                    if sock is not None:
                         try:
-                            self._sock.close()
+                            sock.close()
                         except OSError:
                             pass
-                        self._sock = None
                     if attempt == 1:
                         log.warning(
                             "sidecar unreachable (%s); falling back to CPU", e
@@ -229,6 +264,13 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--backend", default="tpu", choices=["cpu", "tpu"])
     p.add_argument("--max-batch", type=int, default=8192)
+    p.add_argument(
+        "--min-bucket",
+        type=int,
+        default=1024,
+        help="smallest jit bucket width; fewer widths = faster warmup "
+        "(small urgent batches pad up, ~12 ms device time at 1024 lanes)",
+    )
     p.add_argument("--max-delay", type=float, default=0.002)
     p.add_argument(
         "--no-warmup", action="store_true", help="skip bucket pre-compilation"
@@ -239,9 +281,15 @@ def main(argv: list[str] | None = None) -> None:
         from ..ops import enable_persistent_cache
 
         enable_persistent_cache()
-    backend = make_backend(args.backend)
+        backend = make_backend(args.backend, min_bucket=args.min_bucket)
+    else:
+        backend = make_backend(args.backend)
+    from ..utils.logging import quiet_jax_logs
+
+    quiet_jax_logs(args.verbose)
     if not args.no_warmup:
         warmup_backend(backend, args.max_batch)
+        quiet_jax_logs(args.verbose)  # device init may reconfigure logging
     asyncio.run(
         serve(
             (args.host, args.port),

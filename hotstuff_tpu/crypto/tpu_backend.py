@@ -27,6 +27,7 @@ class TpuBackend(CryptoBackend):
         self,
         crossover: int = 64,
         max_bucket: int = 8192,
+        min_bucket: int = 128,
         mesh=None,
         sharded: bool = False,
     ):
@@ -50,7 +51,7 @@ class TpuBackend(CryptoBackend):
             # format + threaded upload pipeline either way.
             kernel = "w4" if jax.default_backend() == "cpu" else "pallas"
             self._verifier = Ed25519TpuVerifier(
-                max_bucket=max_bucket, kernel=kernel
+                min_bucket=min_bucket, max_bucket=max_bucket, kernel=kernel
             )
         self._cpu = CpuBackend()
         self.crossover = crossover

@@ -218,11 +218,16 @@ class Core:
         ok = await payload.verify_async(self.committee, self.verification_service)
         if not ok:
             raise InvalidPayloadSignatureError(payload.author.short())
+        # Store + queue as soon as the REAL signature verifies: consensus
+        # blocks on payload availability, and the synthetic workload below is
+        # pure load whose result never gates acceptance (the reference
+        # verifies pre-generated triples, mempool/src/core.rs:211-224 — the
+        # outcome is measured, not consumed).
+        await self._store_payload(payload)
+        self._queue_insert(payload.digest())
         coro = self._synthetic_coro("OTHER", len(payload.transactions))
         if coro is not None:
             await coro  # already inside a bounded background task
-        await self._store_payload(payload)
-        self._queue_insert(payload.digest())
 
     def _queue_insert(self, digest: Digest) -> None:
         if digest in self._cleaned:

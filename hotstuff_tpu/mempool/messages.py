@@ -47,11 +47,13 @@ class Payload:
         return self.signature.verify(self.digest(), self.author)
 
     async def verify_async(self, committee, service) -> bool:
-        """Signature check through the BatchVerificationService (coalesced
-        off-loop backend dispatch; non-urgent — payload ingress does not gate
-        round advancement the way QC formation does)."""
+        """Signature check through the BatchVerificationService. Urgent:
+        consensus blocks on payload AVAILABILITY (MempoolDriver verify ->
+        Wait, consensus/src/mempool.rs:45-60), and a payload is only stored
+        once this check passes — queueing one signature behind a large
+        workload dispatch would stall round progress."""
         return await service.verify(
-            self.digest().data, self.author, self.signature, urgent=False
+            self.digest().data, self.author, self.signature, urgent=True
         )
 
     def sample_tx_ids(self) -> list[int]:

@@ -20,12 +20,19 @@ class UtcMsFormatter(logging.Formatter):
         return f"{time.strftime('%Y-%m-%dT%H:%M:%S', ct)}.{int(record.msecs):03d}Z"
 
 
+_LEVEL = logging.INFO  # last level chosen by setup_logging
+
+
+def _level_of(verbosity: int) -> int:
+    level = [logging.ERROR, logging.WARNING, logging.INFO][min(verbosity, 2)]
+    return logging.DEBUG if verbosity >= 3 else level
+
+
 def setup_logging(verbosity: int = 2, stream=None) -> None:
     """-v count -> level, like env_logger (node/src/main.rs:43-53):
     0=ERROR, 1=WARNING, 2=INFO, 3+=DEBUG. Logs go to stderr."""
-    level = [logging.ERROR, logging.WARNING, logging.INFO][min(verbosity, 2)]
-    if verbosity >= 3:
-        level = logging.DEBUG
+    global _LEVEL
+    level = _LEVEL = _level_of(verbosity)
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(
         UtcMsFormatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
@@ -34,3 +41,23 @@ def setup_logging(verbosity: int = 2, stream=None) -> None:
     root.handlers.clear()
     root.addHandler(handler)
     root.setLevel(level)
+
+
+def quiet_jax_logs(verbosity: int = 2) -> None:
+    """Cap jax's internal loggers (compilation-cache tracing logs every key
+    lookup at DEBUG, duplicated by jax's own stderr handler — tens of MB per
+    benchmark run) and re-assert the root level: the TPU device plugin
+    flips the root logger to DEBUG during device init. Call AFTER
+    `import jax`, and again after the first device dispatch."""
+    level = logging.WARNING if verbosity < 3 else logging.DEBUG
+    for name in ("jax", "jaxlib"):
+        lg = logging.getLogger(name)
+        lg.setLevel(level)
+        lg.handlers.clear()  # drop jax's duplicate stderr handler
+    for name in list(logging.root.manager.loggerDict):
+        if name.startswith(("jax.", "jaxlib.")):
+            lg = logging.getLogger(name)
+            lg.setLevel(logging.NOTSET)  # inherit from the capped parent
+            lg.handlers.clear()
+            lg.propagate = True
+    logging.getLogger().setLevel(_LEVEL)
