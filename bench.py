@@ -122,6 +122,53 @@ def bench_e2e(msgs, pks, sigs, kernel: str, chunk: int, iters: int) -> float:
     return n * iters / (time.perf_counter() - t0)
 
 
+def _qc_batch(committee: int, total: int, seed: int = 7):
+    """QC-shaped workload: Q quorum certificates, each with q = 2N/3+1
+    votes over ONE shared digest (the reference's `Signature::verify_batch`
+    shape, crypto/src/lib.rs:194-207 / QC::verify messages.rs:180-198)."""
+    import random
+
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    q = 2 * committee // 3 + 1
+    n_qc = max(1, total // q)
+    rng = random.Random(seed)
+    keys = [
+        Ed25519PrivateKey.from_private_bytes(rng.randbytes(32))
+        for _ in range(committee)
+    ]
+    pks = [k.public_key().public_bytes_raw() for k in keys]
+    msgs, batch_pks, sigs = [], [], []
+    for _ in range(n_qc):
+        digest = rng.randbytes(32)
+        voters = rng.sample(range(committee), q)
+        for v in voters:
+            msgs.append(digest)
+            batch_pks.append(pks[v])
+            sigs.append(keys[v].sign(digest))
+    return msgs, batch_pks, sigs, q, n_qc
+
+
+def bench_committee_scale(
+    kernel: str, chunk: int, cpu_budget: float, total: int, iters: int
+) -> None:
+    """votes/sec at QC-shaped batches, committees 4 -> 100 (SURVEY §5.7:
+    committee size is a first-class scaling dimension; BASELINE configs go
+    to 100 nodes). Prints a table; no JSON (the driver metric is main())."""
+    print("committee  quorum   QCs  votes    cpu_sigs/s  tpu_e2e_sigs/s  speedup")
+    for committee in (4, 10, 16, 64, 100):
+        msgs, pks, sigs, q, n_qc = _qc_batch(committee, total)
+        n = len(msgs)
+        tpu_rate = bench_e2e(msgs, pks, sigs, kernel, chunk, iters)
+        cpu_rate = bench_cpu(msgs, pks, sigs, cpu_budget)
+        print(
+            f"{committee:>9}  {q:>6}  {n_qc:>4}  {n:>5}  "
+            f"{cpu_rate:>10,.0f}  {tpu_rate:>14,.0f}  {tpu_rate / cpu_rate:>6.1f}x"
+        )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16384)
@@ -131,11 +178,23 @@ def main() -> None:
     ap.add_argument("--e2e-iters", type=int, default=3)
     ap.add_argument("--cpu-budget", type=float, default=3.0)
     ap.add_argument("--kernel", default="pallas", choices=["w4", "bits", "pallas"])
+    ap.add_argument(
+        "--committee-scale",
+        action="store_true",
+        help="print the votes/sec vs committee-size table instead of the "
+        "driver JSON line",
+    )
     args = ap.parse_args()
 
     from hotstuff_tpu.ops import enable_persistent_cache
 
     enable_persistent_cache()
+
+    if args.committee_scale:
+        bench_committee_scale(
+            args.kernel, args.chunk, args.cpu_budget, args.batch, args.e2e_iters
+        )
+        return
 
     from __graft_entry__ import _signed_batch
 
