@@ -8,10 +8,11 @@ blocks small (SURVEY.md section 1).
 
 from __future__ import annotations
 
+import hashlib
 import struct
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
-from ..crypto import Digest, PublicKey, SecretKey, Signature, sha512_32
+from ..crypto import Digest, PublicKey, SecretKey, Signature
 from ..utils.serde import Reader, SerdeError, Writer
 
 Transaction = bytes
@@ -22,23 +23,39 @@ class Payload:
     transactions: tuple[Transaction, ...]
     author: PublicKey
     signature: Signature
+    # digest cache: a payload's digest is read on every store/queue/verify/
+    # log touch (a ~30-hash recompute per touch dominated the mempool
+    # profile); length-prefixed single-pass hash, computed once.
+    _digest: Digest | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     @staticmethod
-    def make_digest(author: PublicKey, transactions: list[Transaction]) -> Digest:
-        h = b"HSPAYLOAD" + author.data + struct.pack("<I", len(transactions))
+    def make_digest(author: PublicKey, transactions) -> Digest:
+        h = hashlib.sha512()
+        h.update(b"HSPAYLOAD")
+        h.update(author.data)
+        h.update(struct.pack("<I", len(transactions)))
         for tx in transactions:
-            h += sha512_32(tx)
-        return Digest(sha512_32(h))
+            h.update(struct.pack("<I", len(tx)))  # keeps the encoding injective
+            h.update(tx)
+        return Digest(h.digest()[:32])
 
     @staticmethod
     def new_from_key(
         transactions: list[Transaction], author: PublicKey, secret: SecretKey
     ) -> "Payload":
         digest = Payload.make_digest(author, transactions)
-        return Payload(tuple(transactions), author, Signature.new(digest, secret))
+        payload = Payload(tuple(transactions), author, Signature.new(digest, secret))
+        object.__setattr__(payload, "_digest", digest)  # seed the cache
+        return payload
 
     def digest(self) -> Digest:
-        return Payload.make_digest(self.author, list(self.transactions))
+        if self._digest is None:
+            object.__setattr__(
+                self, "_digest", Payload.make_digest(self.author, self.transactions)
+            )
+        return self._digest
 
     def size(self) -> int:
         return sum(len(tx) for tx in self.transactions)
