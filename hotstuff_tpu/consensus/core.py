@@ -345,7 +345,12 @@ class Core:
 
         selector = Selector()
         selector.add("message", self.core_channel.get)
-        selector.add("timer", self.timer.wait)
+        # The pacemaker loses ties: a proposal already queued when the timer
+        # expires must be processed first, or _local_timeout_round's
+        # last_voted_round bump would withhold the vote for a block that
+        # arrived in time (the reference's randomized select! has this race
+        # half the time; here it is deterministic).
+        selector.add("timer", self.timer.wait, priority=1)
         while True:
             branch, value = await selector.next()
             try:

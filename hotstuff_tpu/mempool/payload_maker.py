@@ -93,7 +93,9 @@ class PayloadMaker:
                 # went to per-tx actor wakeups before this) — but yield to
                 # any pending consensus-driven make request: starving it
                 # would stall Core._get_payload and halt round progress.
-                while self._make_requests.empty():
+                # NOTE: the request may sit in the selector's armed task
+                # (which already consumed the queue item), so check both.
+                while not selector.ready("make") and self._make_requests.empty():
                     try:
                         tx = self.tx_in.get_nowait()
                     except asyncio.QueueEmpty:

@@ -57,15 +57,34 @@ class Selector:
     def __init__(self) -> None:
         self._factories: dict[str, Any] = {}
         self._pending: dict[str, asyncio.Task] = {}
+        self._priority: dict[str, int] = {}
+        self._last: str | None = None  # round-robin fairness cursor
 
-    def add(self, name: str, factory) -> None:
+    def add(self, name: str, factory, priority: int = 0) -> None:
+        """Register a branch. Lower `priority` wins ties (same-instant
+        readiness); rotation for fairness applies only WITHIN a priority
+        class. Use a higher number for branches that must lose ties, e.g.
+        a pacemaker timer that should not beat a proposal already queued
+        (firing the timeout first would bump last_voted_round and withhold
+        the vote for a block that arrived in time)."""
         self._factories[name] = factory
+        self._priority[name] = priority
 
     def remove(self, name: str) -> None:
         self._factories.pop(name, None)
+        self._priority.pop(name, None)
         task = self._pending.pop(name, None)
         if task is not None:
             task.cancel()
+
+    def ready(self, name: str) -> bool:
+        """True iff `name`'s armed awaitable has already completed — i.e. a
+        value is waiting to be returned by the next `next()` call. Lets a
+        branch handler's inner fast-path loop yield to a higher-priority
+        branch (the armed task consumes the queue item, so checking the
+        queue's emptiness misses it)."""
+        task = self._pending.get(name)
+        return task is not None and task.done()
 
     async def next(self) -> tuple[str, Any]:
         """Wait for the first ready branch; returns (name, value)."""
@@ -76,13 +95,30 @@ class Selector:
             done, _ = await asyncio.wait(
                 self._pending.values(), return_when=asyncio.FIRST_COMPLETED
             )
-            # Deterministic order: iterate registration order, not set order.
-            for name in list(self._factories):
+            # Deterministic round-robin within each priority class: start
+            # AFTER the branch served last, so a branch whose source is
+            # continuously ready (e.g. a flooded tx channel) cannot starve
+            # later-registered branches (tokio's select! randomizes for the
+            # same reason; rotation keeps tests deterministic).
+            names = sorted(
+                self._factories, key=lambda n: self._priority.get(n, 0)
+            )
+            if self._last in names:
+                prio = self._priority.get(self._last, 0)
+                cls = [n for n in names if self._priority.get(n, 0) == prio]
+                i = cls.index(self._last) + 1
+                rotated = cls[i:] + cls[:i]
+                it = iter(rotated)
+                names = [
+                    next(it) if self._priority.get(n, 0) == prio else n
+                    for n in names
+                ]
+            for name in names:
                 task = self._pending.get(name)
                 if task is not None and task.done() and task in done:
                     del self._pending[name]
-                    value = task.result()
-                    return name, value
+                    self._last = name
+                    return name, task.result()
 
     def close(self) -> None:
         for task in self._pending.values():

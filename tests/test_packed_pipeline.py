@@ -1,0 +1,228 @@
+"""Round-3 crypto path: packed wire-format staging + pipelined verifier,
+urgent dispatch bypass, and payload-maker intake guards.
+
+The packed path is the production transport for TPU verification
+(ops/ed25519.prepare_batch_packed -> Ed25519TpuVerifier packed pipeline);
+these tests pin its parity with the f32 path and with OpenSSL, on the CPU
+backend (conftest forces the virtual CPU mesh — same code path as TPU).
+"""
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from hotstuff_tpu.ops import ed25519 as ed
+
+
+def _signed(n, seed=3, msg_len=32):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    rng = random.Random(seed)
+    msgs, pks, sigs = [], [], []
+    for _ in range(n):
+        sk = Ed25519PrivateKey.from_private_bytes(rng.randbytes(32))
+        m = rng.randbytes(msg_len)
+        msgs.append(m)
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+    return msgs, pks, sigs
+
+
+class TestPackedStaging:
+    def test_native_matches_python(self):
+        msgs, pks, sigs = _signed(33)
+        native = ed.prepare_batch_packed(msgs, pks, sigs, allow_native=True)
+        py = ed.prepare_batch_packed(msgs, pks, sigs, allow_native=False)
+        assert np.array_equal(native["packed"], py["packed"])
+        assert np.array_equal(native["s_ok"], py["s_ok"])
+
+    def test_packed_rows_match_f32_staging(self):
+        msgs, pks, sigs = _signed(17)
+        packed = ed.prepare_batch_packed(msgs, pks, sigs, allow_native=False)
+        f32 = ed.prepare_batch(msgs, pks, sigs, allow_native=False)
+        p = packed["packed"]
+        # rows 0-31 = A (with sign bit), 96-127 = h; f32 staging splits the
+        # sign bit out of a_y and pre-nibbles the scalars
+        a_bytes = p[0:32].astype(np.float32)
+        a_bytes[31] = a_bytes[31] % 128
+        assert np.array_equal(a_bytes, f32["a_y"])
+        assert np.array_equal((p[31] >> 7).astype(np.float32), f32["a_sign"])
+        assert np.array_equal(p[32:64].astype(np.float32), f32["r_enc"])
+        h_lo = (p[96:128] & 0x0F).astype(np.float32)
+        h_hi = (p[96:128] >> 4).astype(np.float32)
+        assert np.array_equal(f32["h_digits"][0::2], h_lo)
+        assert np.array_equal(f32["h_digits"][1::2], h_hi)
+
+    def test_non_canonical_s_flagged(self):
+        msgs, pks, sigs = _signed(4)
+        sigs[2] = sigs[2][:32] + int(ed.L_ORDER).to_bytes(32, "little")
+        staged = ed.prepare_batch_packed(msgs, pks, sigs)
+        assert staged["s_ok"].tolist() == [True, True, False, True]
+
+
+class TestPipelinedVerifier:
+    def test_chunked_pipeline_matches_openssl(self):
+        msgs, pks, sigs = _signed(300)
+        bad = [0, 150, 299]
+        for i in bad:
+            b = bytearray(sigs[i])
+            b[5] ^= 0xFF
+            sigs[i] = bytes(b)
+        v = ed.Ed25519TpuVerifier(max_bucket=256, kernel="w4", chunk=128)
+        mask = v.verify_batch_mask(msgs, pks, sigs)
+        want = np.ones(300, bool)
+        want[bad] = False
+        assert np.array_equal(mask, want)
+
+    def test_empty_batch(self):
+        v = ed.Ed25519TpuVerifier(max_bucket=128, kernel="w4")
+        assert v.verify_batch_mask([], [], []).shape == (0,)
+
+    def test_single_chunk_path(self):
+        msgs, pks, sigs = _signed(40)
+        v = ed.Ed25519TpuVerifier(max_bucket=128, kernel="w4", chunk=128)
+        assert v.verify_batch_mask(msgs, pks, sigs).all()
+
+    def test_packed_false_legacy_path(self):
+        msgs, pks, sigs = _signed(20)
+        v = ed.Ed25519TpuVerifier(max_bucket=128, kernel="w4", packed=False)
+        assert v.verify_batch_mask(msgs, pks, sigs).all()
+
+
+class TestUrgentBypass:
+    def test_urgent_flush_bypasses_busy_dispatch_slots(self, run_async):
+        """With every dispatch slot held by a slow backend call, an urgent
+        group must still dispatch immediately (consensus-critical QC checks
+        must not wait out a device round trip)."""
+        from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
+        from hotstuff_tpu.crypto.backend import CpuBackend
+        from hotstuff_tpu.crypto.batch_service import BatchVerificationService
+
+        class SlowBackend(CpuBackend):
+            def __init__(self, slow_event):
+                super().__init__()
+                self._slow = slow_event
+
+            def verify_batch_mask(self, messages, keys, signatures):
+                if len(messages) > 1:  # the big non-urgent batches
+                    self._slow.wait(timeout=5)
+                return super().verify_batch_mask(messages, keys, signatures)
+
+        async def body():
+            import threading
+
+            release = threading.Event()
+            svc = BatchVerificationService(
+                SlowBackend(release), max_delay=0.001, max_concurrent_dispatches=1
+            )
+            rng = random.Random(1)
+            pk, sk = generate_keypair(rng)
+            d = Digest.of(b"block")
+            sig = Signature.new(d, sk)
+            # occupy the single dispatch slot with a slow 2-item group
+            slow = asyncio.create_task(
+                svc.verify_group([d.data, d.data], [(pk, sig), (pk, sig)])
+            )
+            await asyncio.sleep(0.05)  # let it flush + block in the backend
+            # urgent single check must complete while the slot is held
+            ok = await asyncio.wait_for(
+                svc.verify(d.data, pk, sig, urgent=True), timeout=1.0
+            )
+            assert ok
+            release.set()
+            assert await slow == [True, True]
+
+        run_async(body())
+
+
+class TestPayloadMakerGuards:
+    def test_oversized_tx_dropped(self, run_async):
+        from hotstuff_tpu.crypto import SignatureService
+        from hotstuff_tpu.mempool.payload_maker import PayloadMaker
+        from hotstuff_tpu.utils.actors import channel
+        from tests.common import keys
+
+        async def body():
+            pk, sk = keys(1)[0]
+            tx_in, core = channel(), channel()
+            maker = PayloadMaker(pk, SignatureService(sk), 100, 0, tx_in, core)
+            await tx_in.put(b"x" * 500)  # oversized: dropped
+            await tx_in.put(b"y" * 60)
+            await asyncio.sleep(0.05)  # let the maker ingest both
+            payload = await maker.request_make()
+            assert payload.transactions == (b"y" * 60,)
+
+        run_async(body())
+
+    def test_make_request_not_starved_by_tx_stream(self, run_async):
+        """A consensus-driven make request must be served even while the tx
+        queue is continuously refilled (drain-loop starvation guard)."""
+        from hotstuff_tpu.crypto import SignatureService
+        from hotstuff_tpu.mempool.payload_maker import PayloadMaker
+        from hotstuff_tpu.utils.actors import channel, spawn
+        from tests.common import keys
+
+        async def body():
+            pk, sk = keys(1)[0]
+            tx_in, core = channel(), channel()
+            maker = PayloadMaker(
+                pk, SignatureService(sk), 10_000, 0, tx_in, core
+            )
+
+            stop = asyncio.Event()
+
+            async def flood():
+                while not stop.is_set():
+                    await tx_in.put(b"t" * 64)
+                    await asyncio.sleep(0)
+
+            spawn(flood())
+            try:
+                payload = await asyncio.wait_for(maker.request_make(), 2.0)
+                assert payload is not None
+            finally:
+                stop.set()
+
+        run_async(body())
+
+
+class TestSelectorFairness:
+    def test_round_robin_no_starvation(self, run_async):
+        from hotstuff_tpu.utils.actors import Selector, channel
+
+        async def body():
+            a, b = channel(), channel()
+            sel = Selector()
+            sel.add("a", a.get)
+            sel.add("b", b.get)
+            for _ in range(10):
+                await a.put("A")
+            await b.put("B")
+            served = [await sel.next() for _ in range(5)]
+            names = [n for n, _ in served]
+            assert "b" in names, f"flooded branch starved b: {names}"
+
+        run_async(body())
+
+    def test_priority_branch_loses_ties(self, run_async):
+        """A priority-1 branch (the pacemaker pattern) must lose ties to
+        priority-0 branches even when both are continuously ready."""
+        from hotstuff_tpu.utils.actors import Selector, channel
+
+        async def body():
+            msg, timer = channel(), channel()
+            sel = Selector()
+            sel.add("message", msg.get)
+            sel.add("timer", timer.get, priority=1)
+            await timer.put("T")
+            for _ in range(3):
+                await msg.put("M")
+            await asyncio.sleep(0.01)  # both branches armed + done
+            order = [(await sel.next())[0] for _ in range(4)]
+            assert order == ["message", "message", "message", "timer"], order
+
+        run_async(body())
