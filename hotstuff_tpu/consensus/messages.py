@@ -200,6 +200,10 @@ class Block:
     round: Round
     payload: tuple[Digest, ...]
     signature: Signature
+    # digest cache: read on every vote/store/commit/sync touch
+    _digest: Digest | None = field(
+        default=None, init=False, repr=False, compare=False
+    )
 
     @staticmethod
     def genesis() -> "Block":
@@ -216,11 +220,13 @@ class Block:
         return self.round == 0
 
     def digest(self) -> Digest:
-        h = b"HSBLOCK" + self.author.data + struct.pack("<Q", self.round)
-        for d in self.payload:
-            h += d.data
-        h += self.qc.hash.data + struct.pack("<Q", self.qc.round)
-        return Digest(sha512_32(h))
+        if self._digest is None:
+            object.__setattr__(
+                self,
+                "_digest",
+                Block.make_digest(self.author, self.round, self.payload, self.qc),
+            )
+        return self._digest
 
     def parent(self) -> Digest:
         return self.qc.hash

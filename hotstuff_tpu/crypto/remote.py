@@ -171,6 +171,16 @@ async def _read_exact(reader: asyncio.StreamReader, n: int) -> bytes:
     return await reader.readexactly(n)
 
 
+# Ingress caps: the sidecar is a local trusted surface, but a buggy or
+# compromised co-tenant must not be able to OOM the process that owns the
+# accelerator (SURVEY §5.3: verify-everything-at-ingress discipline).
+# Per-item caps alone don't bound a request's aggregate size, so the
+# cumulative bytes buffered per request are capped too.
+MAX_REQUEST_ITEMS = 1_000_000
+MAX_MESSAGE_LEN = 16 * 1024 * 1024
+MAX_REQUEST_BYTES = 256 * 1024 * 1024
+
+
 async def _handle_connection(reader, writer, service, urgent_below: int):
     peer = writer.get_extra_info("peername")
     log.debug("sidecar connection from %s", peer)
@@ -180,10 +190,34 @@ async def _handle_connection(reader, writer, service, urgent_below: int):
                 (n,) = struct.unpack("<I", await _read_exact(reader, 4))
             except (asyncio.IncompleteReadError, ConnectionResetError):
                 break
+            if n > MAX_REQUEST_ITEMS:
+                log.warning(
+                    "dropping connection %s: request of %s items exceeds cap",
+                    peer,
+                    n,
+                )
+                break
             msgs: list[bytes] = []
             pairs: list[tuple[PublicKey, Signature]] = []
+            total_bytes = 0
             for _ in range(n):
                 (mlen,) = struct.unpack("<I", await _read_exact(reader, 4))
+                if mlen > MAX_MESSAGE_LEN:
+                    log.warning(
+                        "dropping connection %s: %s B message exceeds cap",
+                        peer,
+                        mlen,
+                    )
+                    return
+                total_bytes += mlen + 100  # + keys/sig/framing overhead
+                if total_bytes > MAX_REQUEST_BYTES:
+                    log.warning(
+                        "dropping connection %s: request exceeds %s B "
+                        "aggregate cap",
+                        peer,
+                        MAX_REQUEST_BYTES,
+                    )
+                    return
                 m = await _read_exact(reader, mlen)
                 pk = PublicKey(await _read_exact(reader, 32))
                 sig = Signature(await _read_exact(reader, 64))

@@ -182,3 +182,48 @@ def test_no_double_vote_same_round(run_async, base_port):
             await asyncio.wait_for(network_tx.get(), 0.5)
 
     run_async(body())
+
+
+def test_equivocating_leader_gets_one_vote(run_async, base_port):
+    """Byzantine leader sends TWO different valid blocks for the same round:
+    a correct replica votes for the first and withholds a vote for the
+    second (safety rule: last_voted_round strictly increases —
+    consensus/src/core.rs:106-123)."""
+    from hotstuff_tpu.crypto import Digest
+    from hotstuff_tpu.consensus.messages import QC
+    from tests.common import _secret_of
+
+    async def body():
+        cmt = committee(base_port)
+        elector = LeaderElector(cmt)
+        leader = elector.get_leader(1)
+        b1 = Block.new_from_key(
+            QC.genesis(), None, leader, 1, [Digest.of(b"tx-a")], _secret_of(leader)
+        )
+        b1_equiv = Block.new_from_key(
+            QC.genesis(), None, leader, 1, [Digest.of(b"tx-b")], _secret_of(leader)
+        )
+        assert b1.digest() != b1_equiv.digest()
+        next_leader = elector.get_leader(2)
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (leader, next_leader)
+        )
+        core, core_channel, network_tx, _ = make_core(idx, cmt)
+        spawn(core.run())
+        await core_channel.put(b1)
+        msg = await asyncio.wait_for(network_tx.get(), 10)
+        vote = decode_consensus_message(msg.data)
+        assert isinstance(vote, Vote) and vote.hash == b1.digest()
+        # the equivocated block must produce NO second vote
+        await core_channel.put(b1_equiv)
+        with pytest.raises(asyncio.TimeoutError):
+            while True:
+                msg = await asyncio.wait_for(network_tx.get(), 1.0)
+                extra = decode_consensus_message(msg.data)
+                assert not (
+                    isinstance(extra, Vote) and extra.round == 1
+                ), "replica voted twice in round 1 (equivocation!)"
+
+    run_async(body())

@@ -78,3 +78,47 @@ def test_unreachable_sidecar_falls_back_to_cpu(triples, run_async, base_port):
         assert backend.stats["cpu_batches"] == 1
 
     run_async(body())
+
+
+def test_oversized_request_dropped_server_survives(triples, run_async, base_port):
+    """A request claiming an absurd item count or message length must drop
+    the connection without killing the sidecar; honest clients keep working."""
+    import socket
+    import struct
+
+    async def body():
+        server = asyncio.create_task(
+            serve(("127.0.0.1", base_port), CpuBackend(), max_delay=0.001)
+        )
+        await asyncio.sleep(0.2)
+
+        def attack_counts():
+            s = socket.create_connection(("127.0.0.1", base_port), timeout=5)
+            s.sendall(struct.pack("<I", 0xFFFFFFFF))  # 4 billion items
+            # server must close on us without replying
+            s.settimeout(2)
+            data = s.recv(4)
+            s.close()
+            return data
+
+        def attack_mlen():
+            s = socket.create_connection(("127.0.0.1", base_port), timeout=5)
+            s.sendall(struct.pack("<I", 1) + struct.pack("<I", 0x7FFFFFFF))
+            s.settimeout(2)
+            data = s.recv(4)
+            s.close()
+            return data
+
+        assert await asyncio.to_thread(attack_counts) == b""
+        assert await asyncio.to_thread(attack_mlen) == b""
+
+        # honest client still served after both attacks
+        backend = RemoteBackend(("127.0.0.1", base_port), crossover=1)
+        msgs = [m for m, _, _ in triples]
+        keys = [k for _, k, _ in triples]
+        sigs = [s for _, _, s in triples]
+        mask = await asyncio.to_thread(backend.verify_batch_mask, msgs, keys, sigs)
+        assert mask == [True] * len(triples)
+        server.cancel()
+
+    run_async(body())
