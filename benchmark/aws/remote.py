@@ -113,15 +113,50 @@ class Bench:
         return key_files
 
     def _run_single(
-        self, hosts: list[str], rate: int, bench: BenchParameters, debug: bool
+        self,
+        hosts: list[str],
+        rate: int,
+        bench: BenchParameters,
+        debug: bool,
+        crypto: str = "cpu",
     ) -> None:
-        """Launch nodes + clients over ssh (remote.py:200-247)."""
+        """Launch nodes + clients over ssh (remote.py:200-247). With
+        crypto="tpu", each host boots its own crypto sidecar (one
+        accelerator per host) and the node connects as a remote client —
+        the same wiring LocalBench uses on one machine."""
+        self._run_on(hosts, CommandMaker.kill())  # clear stale node/sidecar procs
         boot = hosts[: len(hosts) - bench.faults]
         per_client_rate = max(1, rate // len(boot))
         consensus_addrs = [f"{h}:{self.settings.base_port}" for h in boot]
+        sidecar_port = self.settings.base_port - 100
         for i, host in enumerate(boot):
+            c = self._connect(host)
+            if crypto == "tpu":
+                sidecar_cmd = CommandMaker.run_sidecar(sidecar_port, "tpu", debug=debug)
+                c.run(
+                    f"cd {self.settings.repo_name} && "
+                    f"nohup {sidecar_cmd} > sidecar.log 2>&1 &",
+                    hide=True,
+                )
+                # Nodes silently fall back to CPU if the sidecar is not up,
+                # which would record CPU numbers as a "tpu" run — wait for
+                # the readiness line like LocalBench does (local.py:96-111).
+                deadline = time.time() + 480
+                while time.time() < deadline:
+                    r = c.run(
+                        f"grep -l 'successfully booted' "
+                        f"{self.settings.repo_name}/sidecar.log || true",
+                        hide=True,
+                    )
+                    if r.stdout.strip():
+                        break
+                    time.sleep(5)
+                else:
+                    raise BenchError(f"crypto sidecar on {host} never booted")
             node_cmd = CommandMaker.run_node(
                 f".node-{i}.json", ".committee.json", ".db/log", ".parameters.json",
+                crypto="remote" if crypto == "tpu" else crypto,
+                crypto_addr=f"127.0.0.1:{sidecar_port}" if crypto == "tpu" else None,
                 debug=debug,
             )
             client_cmd = CommandMaker.run_client(
@@ -130,7 +165,6 @@ class Bench:
                 per_client_rate,
                 consensus_addrs,
             )
-            c = self._connect(host)
             c.run(
                 f"cd {self.settings.repo_name} && "
                 f"nohup {node_cmd} > node.log 2>&1 &",
@@ -152,9 +186,22 @@ class Bench:
             c = self._connect(host)
             c.get(join(self.settings.repo_name, "node.log"), f"logs/node-{i}.log")
             c.get(join(self.settings.repo_name, "client.log"), f"logs/client-{i}.log")
+            try:
+                c.get(
+                    join(self.settings.repo_name, "sidecar.log"),
+                    f"logs/sidecar-{i}.log",
+                )
+            except OSError:
+                pass  # cpu runs have no sidecar
         return LogParser.process("logs", faults)
 
-    def run(self, bench_params: dict, node_params: dict, debug: bool = False) -> None:
+    def run(
+        self,
+        bench_params: dict,
+        node_params: dict,
+        debug: bool = False,
+        crypto: str = "cpu",
+    ) -> None:
         """Full sweep: nodes x rate x runs (remote.py:249-301)."""
         bench = BenchParameters(bench_params)
         params = NodeParameters(node_params)
@@ -168,7 +215,7 @@ class Bench:
             for rate in bench.rate:
                 for run_idx in range(bench.runs):
                     print(f"run {run_idx}: {n} nodes @ {rate} tx/s")
-                    self._run_single(hosts, rate, bench, debug)
+                    self._run_single(hosts, rate, bench, debug, crypto=crypto)
                     parser = self._logs(hosts, bench.faults)
                     fname = f"results/bench-{n}-{rate}-{bench.tx_size}-{bench.faults}.txt"
                     with open(fname, "a") as f:

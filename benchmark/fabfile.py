@@ -116,10 +116,12 @@ def install(ctx=None):
 
 
 @task
-def remote(ctx=None, debug=False):
+def remote(ctx=None, debug=False, crypto="cpu"):
     from .aws.remote import Bench
 
-    Bench().run(REMOTE_BENCH_PARAMS, LOCAL_NODE_PARAMS, debug=bool(debug))
+    Bench().run(
+        REMOTE_BENCH_PARAMS, LOCAL_NODE_PARAMS, debug=bool(debug), crypto=crypto
+    )
 
 
 @task

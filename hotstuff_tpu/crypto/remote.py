@@ -305,6 +305,13 @@ def main(argv: list[str] | None = None) -> None:
         help="smallest jit bucket width; fewer widths = faster warmup "
         "(small urgent batches pad up, ~12 ms device time at 1024 lanes)",
     )
+    p.add_argument(
+        "--multihost",
+        action="store_true",
+        help="join a multi-host JAX job (parallel.mesh.init_multihost; "
+        "coordinator from the standard JAX_COORDINATOR_ADDRESS env) and "
+        "shard verification batches over every chip in the job",
+    )
     p.add_argument("--max-delay", type=float, default=0.002)
     p.add_argument(
         "--no-warmup", action="store_true", help="skip bucket pre-compilation"
@@ -315,7 +322,13 @@ def main(argv: list[str] | None = None) -> None:
         from ..ops import enable_persistent_cache
 
         enable_persistent_cache()
-        backend = make_backend(args.backend, min_bucket=args.min_bucket)
+        if args.multihost:
+            from ..parallel.mesh import init_multihost
+
+            mesh = init_multihost()
+            backend = make_backend(args.backend, mesh=mesh)
+        else:
+            backend = make_backend(args.backend, min_bucket=args.min_bucket)
     else:
         backend = make_backend(args.backend)
     from ..utils.logging import quiet_jax_logs

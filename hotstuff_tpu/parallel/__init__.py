@@ -4,6 +4,7 @@ meshes with ICI collectives (SURVEY.md §2.8, §5.7)."""
 from .mesh import (
     ShardedEd25519Verifier,
     default_mesh,
+    init_multihost,
     mesh_2d,
     sharded_qc_verify_fn,
     sharded_verify_fn,
@@ -12,6 +13,7 @@ from .mesh import (
 __all__ = [
     "ShardedEd25519Verifier",
     "default_mesh",
+    "init_multihost",
     "mesh_2d",
     "sharded_qc_verify_fn",
     "sharded_verify_fn",

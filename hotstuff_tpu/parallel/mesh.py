@@ -49,6 +49,34 @@ def default_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
     return Mesh(np.array(devs), (axis,))
 
 
+def init_multihost(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> Mesh:
+    """Initialize the multi-host crypto plane and return the global mesh.
+
+    The reference scales its committee across hosts with one process per
+    node and NO cross-host accelerator fabric; here the CRYPTO plane can
+    additionally span hosts: each sidecar process calls this once, JAX's
+    distributed runtime forms the global device set (ICI within a slice,
+    DCN across slices), and the returned 1-D "dp" mesh shards verification
+    batches over every chip in the job (`sharded_verify_fn`). Consensus/
+    mempool control traffic stays on host-side TCP (SURVEY §5.8) — only
+    the batch-verification collectives ride the accelerator fabric.
+
+    Args default from the standard JAX env (JAX_COORDINATOR_ADDRESS etc.)
+    when None; single-process callers can skip this entirely and use
+    `default_mesh()`.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return default_mesh()
+
+
 def mesh_2d(n_qc: int, n_dp: int, devices=None) -> Mesh:
     """(qc, dp) mesh: independent QC batches x vote data-parallel."""
     devs = np.array(devices if devices is not None else jax.devices())

@@ -91,7 +91,12 @@ def test_oversized_request_dropped_server_survives(triples, run_async, base_port
             serve(("127.0.0.1", base_port), CpuBackend(), max_delay=0.001)
         )
         await asyncio.sleep(0.2)
+        try:
+            await _attacks(base_port)
+        finally:
+            server.cancel()
 
+    async def _attacks(base_port):
         def attack_counts():
             s = socket.create_connection(("127.0.0.1", base_port), timeout=5)
             s.sendall(struct.pack("<I", 0xFFFFFFFF))  # 4 billion items
@@ -119,6 +124,5 @@ def test_oversized_request_dropped_server_survives(triples, run_async, base_port
         sigs = [s for _, _, s in triples]
         mask = await asyncio.to_thread(backend.verify_batch_mask, msgs, keys, sigs)
         assert mask == [True] * len(triples)
-        server.cancel()
 
     run_async(body())
