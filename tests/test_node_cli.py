@@ -1,0 +1,64 @@
+"""Node CLI contract: keys file round-trip and the in-process deploy
+testbed (reference node/src/main.rs:22-40, deploy_testbed :94-153)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def test_keys_subcommand(tmp_path):
+    out = tmp_path / "node.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "hotstuff_tpu.node.main", "keys",
+         "--filename", str(out)],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+    assert out.exists()
+    from hotstuff_tpu.node.config import Secret
+
+    secret = Secret.read(str(out))
+    assert len(secret.name.data) == 32
+
+
+def test_deploy_testbed_commits(tmp_path):
+    """`node deploy --nodes 4` must boot an in-process committee that
+    commits blocks (observed via the Committed log lines on stderr)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hotstuff_tpu.node.main", "-vv",
+         "deploy", "--nodes", "4"],
+        cwd=tmp_path,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        deadline = time.time() + 60
+        committed = False
+        lines = []
+        os.set_blocking(proc.stdout.fileno(), False)
+        while time.time() < deadline and not committed:
+            time.sleep(1.0)
+            if proc.poll() is not None:
+                break
+            chunk = proc.stdout.read()
+            if chunk:
+                lines.append(chunk)
+                committed = "Committed B" in "".join(lines)
+        assert proc.poll() is None, (
+            f"deploy testbed exited rc={proc.returncode}:\n" + "".join(lines)[-2000:]
+        )
+        assert committed, (
+            "no block committed within 60s:\n" + "".join(lines)[-2000:]
+        )
+    finally:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait(timeout=10)

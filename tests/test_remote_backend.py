@@ -97,25 +97,33 @@ def test_oversized_request_dropped_server_survives(triples, run_async, base_port
             server.cancel()
 
     async def _attacks(base_port):
-        def attack_counts():
-            s = socket.create_connection(("127.0.0.1", base_port), timeout=5)
-            s.sendall(struct.pack("<I", 0xFFFFFFFF))  # 4 billion items
+        def attack(payload: bytes) -> bytes:
             # server must close on us without replying
-            s.settimeout(2)
-            data = s.recv(4)
-            s.close()
-            return data
-
-        def attack_mlen():
             s = socket.create_connection(("127.0.0.1", base_port), timeout=5)
-            s.sendall(struct.pack("<I", 1) + struct.pack("<I", 0x7FFFFFFF))
+            s.sendall(payload)
             s.settimeout(2)
             data = s.recv(4)
             s.close()
             return data
 
-        assert await asyncio.to_thread(attack_counts) == b""
-        assert await asyncio.to_thread(attack_mlen) == b""
+        # body length beyond the aggregate cap
+        assert await asyncio.to_thread(attack, struct.pack("<I", 0xFFFFFFFF)) == b""
+        # item count beyond the cap (valid body length)
+        body = struct.pack("<I", 0xFFFFFFFF) + b"\x00" * 4
+        assert (
+            await asyncio.to_thread(
+                attack, struct.pack("<I", len(body)) + body
+            )
+            == b""
+        )
+        # malformed: one item claiming a message longer than the body
+        body = struct.pack("<I", 1) + struct.pack("<I", 0x7FFFFF)
+        assert (
+            await asyncio.to_thread(
+                attack, struct.pack("<I", len(body)) + body
+            )
+            == b""
+        )
 
         # honest client still served after both attacks
         backend = RemoteBackend(("127.0.0.1", base_port), crossover=1)
@@ -126,3 +134,26 @@ def test_oversized_request_dropped_server_survives(triples, run_async, base_port
         assert mask == [True] * len(triples)
 
     run_async(body())
+
+
+def test_parse_request_enforces_per_message_cap():
+    """_parse_request must reject an item whose claimed length exceeds
+    MAX_MESSAGE_LEN even when the body actually contains that many bytes
+    (the framing check alone would accept it)."""
+    import struct
+
+    import pytest as _pytest
+
+    from hotstuff_tpu.crypto.remote import (
+        MAX_MESSAGE_LEN,
+        MAX_REQUEST_ITEMS,
+        _parse_request,
+    )
+
+    mlen = MAX_MESSAGE_LEN + 1
+    body = struct.pack("<I", 1) + struct.pack("<I", mlen) + b"\x00" * (mlen + 96)
+    with _pytest.raises(ValueError):
+        _parse_request(memoryview(body))
+    # item-count cap now lives in the parser too
+    with _pytest.raises(ValueError):
+        _parse_request(memoryview(struct.pack("<I", MAX_REQUEST_ITEMS + 1)))
