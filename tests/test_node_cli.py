@@ -28,13 +28,16 @@ def test_keys_subcommand(tmp_path):
 def test_deploy_testbed_commits(tmp_path):
     """`node deploy --nodes 4` must boot an in-process committee that
     commits blocks (observed via the Committed log lines on stderr)."""
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(
         [sys.executable, "-m", "hotstuff_tpu.node.main", "-vv",
          "deploy", "--nodes", "4"],
-        cwd=tmp_path,
+        cwd=tmp_path,  # .db_i stores land in the tmp dir
+        env=env,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
-        text=True,
         start_new_session=True,
     )
     try:
@@ -46,9 +49,9 @@ def test_deploy_testbed_commits(tmp_path):
             time.sleep(1.0)
             if proc.poll() is not None:
                 break
-            chunk = proc.stdout.read()
+            chunk = proc.stdout.read()  # None when no data is available
             if chunk:
-                lines.append(chunk)
+                lines.append(chunk.decode(errors="replace"))
                 committed = "Committed B" in "".join(lines)
         assert proc.poll() is None, (
             f"deploy testbed exited rc={proc.returncode}:\n" + "".join(lines)[-2000:]
