@@ -1,0 +1,103 @@
+"""Property fuzz of the f32-limb GF(2^255-19) substrate against exact
+Python bigints — random op chains within the documented bound discipline
+(field.py header) plus adversarial boundary values. The limb arithmetic
+is the safety-critical novel code under every verification: a single
+inexact f32 product would silently corrupt verification masks.
+"""
+
+import random
+
+import numpy as np
+
+from hotstuff_tpu.ops import field as f
+
+P = f.P
+RNG = random.Random(99)
+
+# Adversarial values: near-p, near-0, all-ones limbs, 2^k edges
+EDGES = [
+    0,
+    1,
+    2,
+    19,
+    P - 1,
+    P - 2,
+    P - 19,
+    (2**255 - 1) % P,  # the unreduced all-ones 255-bit encoding edge
+    2**254,
+    2**200,
+    2**128,
+    int("55" * 32, 16) % P,
+    int("aa" * 32, 16) % P,
+]
+assert len(set(EDGES)) == len(EDGES), "edge values must be distinct"
+
+
+def _cols(values):
+    return np.concatenate([f.limbs_of_int(v % P) for v in values], axis=1)
+
+
+def test_mul_sqr_edge_matrix():
+    """Every edge value times every edge value, mul and sqr."""
+    for a in EDGES:
+        av = _cols([a] * len(EDGES))
+        bv = _cols(EDGES)
+        got = f.int_of_limbs(np.asarray(f.canonical(f.mul(av, bv))))
+        assert got == [(a * b) % P for b in EDGES], f"mul failed for a={a}"
+    sq = f.int_of_limbs(np.asarray(f.canonical(f.sqr(_cols(EDGES)))))
+    assert sq == [(e * e) % P for e in EDGES]
+
+
+def test_random_op_chains_match_bigint():
+    """Chains of (add -> mul/sub/sqr) respecting the lazy-add discipline:
+    at most one lazy add feeds a mul/sub (bounds doc in field.py)."""
+    B = 16
+    for trial in range(20):
+        ints = [RNG.randrange(P) for _ in range(B)]
+        limbs = _cols(ints)
+        for step in range(8):
+            op = RNG.choice(["mul", "sqr", "sub", "addmul"])
+            other = [RNG.randrange(P) for _ in range(B)]
+            ov = _cols(other)
+            if op == "mul":
+                limbs = f.mul(limbs, ov)
+                ints = [(x * y) % P for x, y in zip(ints, other)]
+            elif op == "sqr":
+                limbs = f.sqr(limbs)
+                ints = [(x * x) % P for x in ints]
+            elif op == "sub":
+                limbs = f.sub(limbs, ov)
+                ints = [(x - y) % P for x, y in zip(ints, other)]
+            else:  # one lazy add then a mul (the madd pattern)
+                third = [RNG.randrange(P) for _ in range(B)]
+                limbs = f.mul(f.add(limbs, ov), _cols(third))
+                ints = [((x + y) * z) % P for x, y, z in zip(ints, other, third)]
+        got = f.int_of_limbs(np.asarray(f.canonical(limbs)))
+        assert got == ints, f"chain diverged at trial {trial}"
+
+
+def test_invert_and_pow2523_random():
+    vals = [RNG.randrange(1, P) for _ in range(8)] + [1, P - 1]
+    limbs = _cols(vals)
+    inv = f.int_of_limbs(np.asarray(f.canonical(f.invert(limbs))))
+    assert inv == [pow(v, P - 2, P) for v in vals]
+    pw = f.int_of_limbs(np.asarray(f.canonical(f.pow2523(limbs))))
+    assert pw == [pow(v, (P - 5) // 8, P) for v in vals]
+
+
+def test_canonical_reduces_all_representations():
+    """canonical() must map any in-contract representation (limbs <= ~600,
+    value possibly >= p — the normalized outputs of mul/sub and one lazy
+    add) to THE unique reduced form."""
+    import jax.numpy as jnp
+
+    vals = [P - 1, P, P + 1, 2 * P - 1, 2 * P, 0, 1]
+    # values in [p, 2^256): byte limbs of v itself (v < 2^256, limbs <= 255)
+    reps = np.concatenate([f.limbs_of_int(v) for v in vals], axis=1)
+    got = f.int_of_limbs(np.asarray(f.canonical(jnp.asarray(reps))))
+    assert got == [v % P for v in vals]
+    # a lazy-add representation: limbs up to 2*294 (the documented add bound)
+    a = _cols(vals)
+    lazy = f.add(a, a)
+    got2 = f.int_of_limbs(np.asarray(f.canonical(lazy)))
+    assert got2 == [(2 * v) % P for v in vals]
