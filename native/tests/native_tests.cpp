@@ -28,6 +28,9 @@ int hs_stage_batch(const uint8_t *msgs, const int64_t *offsets,
                    const uint8_t *keys, const uint8_t *sigs, int64_t n,
                    float *a_y, float *a_sign, float *r_enc, float *s_digits,
                    float *h_digits, uint8_t *s_ok);
+int hs_stage_batch_packed(const uint8_t *msgs, const int64_t *offsets,
+                          const uint8_t *keys, const uint8_t *sigs, int64_t n,
+                          uint8_t *packed, uint8_t *s_ok);
 }
 
 static long file_size(const char *path) {
@@ -136,11 +139,47 @@ static void test_staging_invariants() {
   printf("staging invariants: ok\n");
 }
 
+static void test_packed_staging_matches_f32() {
+  // The packed (128, n) u8 wire rows must agree with the f32 staging of the
+  // same inputs: rows 0-31 = raw A, 32-63 = raw R, 64-95 = raw S, and the
+  // h rows' nibbles must equal h_digits.
+  const int64_t n = 3;
+  uint8_t msgs[96];
+  for (int i = 0; i < 96; i++) msgs[i] = (uint8_t)(i ^ 0x5A);
+  int64_t offsets[4] = {0, 32, 64, 96};
+  uint8_t keys[96], sigs[192];
+  for (int i = 0; i < 96; i++) keys[i] = (uint8_t)(i * 7 + 3);
+  for (int i = 0; i < 192; i++) sigs[i] = (uint8_t)(i * 11 + 5);
+  memset(sigs + 32 + 16, 0x00, 16);  // keep item 0's s < L
+
+  std::vector<float> a_y(32 * n), a_sign(n), r_enc(32 * n), s_digits(64 * n),
+      h_digits(64 * n);
+  std::vector<uint8_t> s_ok_f(n), s_ok_p(n), packed(128 * n);
+  assert(hs_stage_batch(msgs, offsets, keys, sigs, n, a_y.data(),
+                        a_sign.data(), r_enc.data(), s_digits.data(),
+                        h_digits.data(), s_ok_f.data()) == 0);
+  assert(hs_stage_batch_packed(msgs, offsets, keys, sigs, n, packed.data(),
+                               s_ok_p.data()) == 0);
+  for (int64_t b = 0; b < n; b++) {
+    assert(s_ok_f[b] == s_ok_p[b]);
+    for (int i = 0; i < 32; i++) {
+      assert(packed[(int64_t)i * n + b] == keys[32 * b + i]);           // A
+      assert(packed[(32 + (int64_t)i) * n + b] == sigs[64 * b + i]);    // R
+      assert(packed[(64 + (int64_t)i) * n + b] == sigs[64 * b + 32 + i]);  // S
+      uint8_t h = packed[(96 + (int64_t)i) * n + b];
+      assert((float)(h & 0x0F) == h_digits[(int64_t)(2 * i) * n + b]);
+      assert((float)(h >> 4) == h_digits[(int64_t)(2 * i + 1) * n + b]);
+    }
+  }
+  printf("packed staging matches f32: ok\n");
+}
+
 int main() {
   test_store_roundtrip("/tmp/hs_native_test_store.log");
   test_store_torn_tail("/tmp/hs_native_test_torn.log");
   test_store_compact("/tmp/hs_native_test_compact.log");
   test_staging_invariants();
+  test_packed_staging_matches_f32();
   printf("ALL NATIVE TESTS PASSED\n");
   return 0;
 }
