@@ -5,13 +5,14 @@ rebuilt as JAX SPMD kernels)."""
 import os
 
 from . import field
-from .ed25519 import Ed25519TpuVerifier, prepare_batch
+from .ed25519 import Ed25519TpuVerifier, prepare_batch, prepare_batch_packed
 
 __all__ = [
     "field",
     "ed25519",
     "Ed25519TpuVerifier",
     "prepare_batch",
+    "prepare_batch_packed",
     "enable_persistent_cache",
 ]
 
