@@ -264,8 +264,11 @@ class Core:
 
         if block.round != self.round:
             return
-        if self.timer is not None:
-            self.timer.reset()
+        # NOTE: deliberately NO timer reset here. The pacemaker re-arms only
+        # on round ADVANCE (core.rs:267-268): resetting on every current-round
+        # block would let a Byzantine leader suppress this replica's Timeout
+        # by re-sending its round-r proposal, and with f crashed replicas the
+        # remaining honest timeouts could no longer reach 2f+1 for a TC.
         vote = await self._make_vote(block)
         if vote is None:
             return

@@ -227,3 +227,47 @@ def test_equivocating_leader_gets_one_vote(run_async, base_port):
                 ), "replica voted twice in round 1 (equivocation!)"
 
     run_async(body())
+
+
+def test_respammed_proposal_does_not_suppress_timeout(run_async, base_port):
+    """Byzantine leader re-sends its round-1 proposal repeatedly: the
+    replica must still fire its round-1 Timeout (pacemaker re-arms only on
+    round ADVANCE, consensus/src/core.rs:267-268 — a per-block reset would
+    let the leader suppress this replica's timeout forever)."""
+    async def body():
+        cmt = committee(base_port)
+        elector = LeaderElector(cmt)
+        b1 = chain(1, cmt)[0]
+        next_leader = elector.get_leader(2)
+        idx = next(
+            i
+            for i, (pk, _) in enumerate(keys())
+            if pk not in (b1.author, next_leader)
+        )
+        core, core_channel, network_tx, _ = make_core(idx, cmt, timeout_ms=1_000)
+        spawn(core.run())
+        # Spam the same valid proposal more often than the timeout period,
+        # CONTINUOUSLY until the timeout is observed: with a per-block timer
+        # reset (the guarded regression) the pacemaker would never fire
+        # while spam is active, so the assertion below would fail.
+        stop_spam = asyncio.Event()
+
+        async def spam():
+            while not stop_spam.is_set():
+                await core_channel.put(b1)
+                await asyncio.sleep(0.05)
+
+        spawn(spam())
+        saw_timeout = False
+        deadline = asyncio.get_running_loop().time() + 6.0
+        try:
+            while asyncio.get_running_loop().time() < deadline and not saw_timeout:
+                msg = await asyncio.wait_for(network_tx.get(), 6.0)
+                decoded = decode_consensus_message(msg.data)
+                if isinstance(decoded, Timeout) and decoded.round == 1:
+                    saw_timeout = True
+        finally:
+            stop_spam.set()
+        assert saw_timeout, "replica's round-1 timeout was suppressed by spam"
+
+    run_async(body())
