@@ -210,7 +210,25 @@ class Store:
         self._path = path
         self._compact_threshold = MIN_COMPACT_BYTES
         self.compactions = 0
+        self._cmd_count = 0
         self._task = spawn(self._run(), name="store-writer")
+
+    def _sweep_obligations(self) -> None:
+        """Drop cancelled waiters and empty keys. Obligations for keys that
+        are NEVER written (e.g. a Byzantine block referencing bogus payload
+        digests, whose waiter the synchronizer later cancels) would otherwise
+        accumulate without bound; amortized over the command stream."""
+        dead = []
+        for key, waiters in self._obligations.items():
+            if not any(w.cancelled() for w in waiters):
+                continue  # nothing to prune; avoid rebuilding live deques
+            live = deque(w for w in waiters if not w.cancelled())
+            if live:
+                self._obligations[key] = live
+            else:
+                dead.append(key)
+        for key in dead:
+            del self._obligations[key]
 
     @property
     def engine_name(self) -> str:
@@ -240,6 +258,9 @@ class Store:
     async def _run(self) -> None:
         while True:
             cmd, args, fut = await self._queue.get()
+            self._cmd_count += 1
+            if self._cmd_count % 4096 == 0:
+                self._sweep_obligations()
             if cmd == "write":
                 key, value = args
                 try:

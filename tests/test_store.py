@@ -52,3 +52,31 @@ def test_persistence_replay(run_async, tmp_path):
 
     run_async(write_phase())
     run_async(read_phase())
+
+
+def test_cancelled_obligations_swept(run_async):
+    """Cancelled notify_read waiters for never-written keys must not
+    accumulate forever (Byzantine blocks can reference bogus digests)."""
+    import asyncio
+
+    from hotstuff_tpu.store import Store
+
+    async def body():
+        store = Store()
+        tasks = []
+        for i in range(50):
+            t = asyncio.get_running_loop().create_task(
+                store.notify_read(b"never-%d" % i)
+            )
+            tasks.append(t)
+        await asyncio.sleep(0.05)
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        # drive the amortized sweep with ordinary traffic
+        for i in range(4200):
+            await store.write(b"k%d" % (i % 7), b"v")
+        assert len(store._obligations) == 0, dict(store._obligations)
+        store.close()
+
+    run_async(body())
