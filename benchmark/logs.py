@@ -8,21 +8,36 @@ Regex-scrapes node and client logs to compute:
     "Verifying OWN/OTHER transaction batch. Size: N" lines -- the
     votes-verified/sec north-star metric)
 
-Raises ParseError if any log contains a traceback or actor crash, like the
-reference raising on panics (logs.py:71-72,88-89).
+Raises ParseError if any log contains a traceback, actor crash, or an
+ERROR-severity line, like the reference raising on `Error`/`panic` matches
+(logs.py:71-72,88-89). Per-log scraping runs in a multiprocessing Pool when
+the host has cores to spare (reference logs.py:27-39) — at 20+ node log
+volumes the regex pass is minutes of single-core work.
 """
 
 from __future__ import annotations
 
+import os
 import re
 from datetime import datetime, timezone
 from glob import glob
+from multiprocessing import Pool
 from os.path import join
 from statistics import mean
 
 
 class ParseError(Exception):
     pass
+
+
+def _check_crash(text: str) -> None:
+    if (
+        "Traceback" in text
+        or " ERROR " in text
+        or "panic" in text
+        or ("actor" in text and "crashed" in text)
+    ):
+        raise ParseError("node or client log contains a crash or error")
 
 
 _TS = r"\[(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
@@ -40,83 +55,126 @@ def _search_all(pattern: str, text: str) -> list[tuple]:
     return re.findall(pattern, text, re.MULTILINE)
 
 
+def _parse_client(text: str) -> dict:
+    """Scrape one client log (runs in a Pool worker)."""
+    _check_crash(text)
+    out: dict = {"size": 0, "rate": 0, "start": None, "samples": {}, "misses": 0}
+    m = re.search(rf"{_TS}.*Transactions size: (\d+) B", text)
+    if m:
+        out["size"] = int(m.group(2))
+    m = re.search(rf"{_TS}.*Transactions rate: (\d+) tx/s", text)
+    if m:
+        out["rate"] = int(m.group(2))
+    m = re.search(rf"{_TS}.*Start sending transactions", text)
+    if m:
+        out["start"] = _to_posix(m.group(1))
+    out["samples"] = {
+        int(sid): _to_posix(ts)
+        for ts, sid in _search_all(
+            rf"{_TS}.*Sending sample transaction (\d+)", text
+        )
+    }
+    out["misses"] = len(_search_all(r"rate too high", text))
+    return out
+
+
+def _parse_node(text: str) -> dict:
+    """Scrape one node log (runs in a Pool worker)."""
+    _check_crash(text)
+    out: dict = {
+        "proposals": {},
+        "commits": {},
+        "committed_payloads": {},
+        "payload_sizes": {},
+        "sample_to_payload": {},
+        "verif_batches": [],
+        "timeouts": 0,
+    }
+    for ts, rnd, digest in _search_all(rf"{_TS}.*Created B(\d+)\((\S+?)\)$", text):
+        t = _to_posix(ts)
+        out["proposals"][digest] = min(out["proposals"].get(digest, t), t)
+    for ts, rnd, digest in _search_all(rf"{_TS}.*Committed B(\d+)\((\S+?)\)$", text):
+        t = _to_posix(ts)
+        out["commits"][digest] = min(out["commits"].get(digest, t), t)
+    for ts, rnd, digest, payload in _search_all(
+        rf"{_TS}.*Committed B(\d+)\((\S+?)\) -> (\S+)$", text
+    ):
+        t = _to_posix(ts)
+        prev = out["committed_payloads"].get(payload)
+        if prev is None or t < prev[1]:
+            out["committed_payloads"][payload] = (digest, t)
+    for ts, payload, size in _search_all(
+        rf"{_TS}.*Payload (\S+) contains (\d+) B", text
+    ):
+        out["payload_sizes"][payload] = int(size)
+    for ts, payload, sid in _search_all(
+        rf"{_TS}.*Payload (\S+) contains sample tx (\d+)", text
+    ):
+        out["sample_to_payload"][int(sid)] = payload
+    for ts, kind, n in _search_all(
+        rf"{_TS}.*Verifying (OWN|OTHER) transaction batch\. Size: (\d+)", text
+    ):
+        out["verif_batches"].append((_to_posix(ts), int(n)))
+    out["timeouts"] = len(_search_all(r"Timeout reached", text))
+    return out
+
+
+def _map_logs(fn, texts: list[str]) -> list[dict]:
+    """Pool-parallel per-log scraping (reference logs.py:27-39); serial when
+    the host is single-core or there is nothing to parallelise."""
+    if len(texts) > 1 and (os.cpu_count() or 1) > 1:
+        with Pool() as p:
+            return p.map(fn, texts)
+    return [fn(t) for t in texts]
+
+
 class LogParser:
     def __init__(self, clients: list[str], nodes: list[str], faults: int = 0) -> None:
         self.faults = faults
         self.committee_size = len(nodes) + faults
 
-        for text in clients + nodes:
-            if "Traceback" in text or "actor" in text and "crashed" in text:
-                raise ParseError("node or client log contains a crash")
-
         # --- client logs ---
         self.size = 0
         self.rate = 0
         self.start = None
-        self.sent_samples: dict[int, float] = {}  # per-client ids are merged
+        self.sent_samples: dict[tuple[int, int], float] = {}
         self.misses = 0
-        for i, text in enumerate(clients):
-            m = re.search(rf"{_TS}.*Transactions size: (\d+) B", text)
-            if m:
-                self.size = int(m.group(2))
-            m = re.search(rf"{_TS}.*Transactions rate: (\d+) tx/s", text)
-            if m:
-                self.rate += int(m.group(2))
-            m = re.search(rf"{_TS}.*Start sending transactions", text)
-            if m:
-                t = _to_posix(m.group(1))
-                self.start = t if self.start is None else min(self.start, t)
-            for ts, sid in _search_all(
-                rf"{_TS}.*Sending sample transaction (\d+)", text
-            ):
-                # Sample ids collide across clients; key by (client, id).
-                self.sent_samples[(i, int(sid))] = _to_posix(ts)
-            self.misses += len(_search_all(r"rate too high", text))
+        for i, c in enumerate(_map_logs(_parse_client, clients)):
+            self.size = self.size or c["size"]
+            self.rate += c["rate"]
+            if c["start"] is not None:
+                self.start = (
+                    c["start"] if self.start is None else min(self.start, c["start"])
+                )
+            # Sample ids collide across clients; key by (client, id).
+            for sid, t in c["samples"].items():
+                self.sent_samples[(i, sid)] = t
+            self.misses += c["misses"]
 
         # --- node logs ---
         self.proposals: dict[str, float] = {}  # block digest -> earliest created
         self.commits: dict[str, float] = {}  # block digest -> earliest commit
         self.committed_payloads: dict[str, tuple[str, float]] = {}  # payload -> (block, t)
         self.payload_sizes: dict[str, int] = {}
-        self.sample_to_payload: dict[tuple[int, int], str] = {}
+        self.sample_to_payload: dict[int, str] = {}
         self.verif_batches: list[tuple[float, int]] = []  # (t, batch size)
         self.timeouts = 0
         self.configs = self._parse_configs(nodes[0] if nodes else "")
-        for node_index, text in enumerate(nodes):
-            for ts, rnd, digest in _search_all(
-                rf"{_TS}.*Created B(\d+)\((\S+?)\)$", text
-            ):
-                t = _to_posix(ts)
-                self.proposals[digest] = min(
-                    self.proposals.get(digest, t), t
-                )
-            for ts, rnd, digest in _search_all(
-                rf"{_TS}.*Committed B(\d+)\((\S+?)\)$", text
-            ):
-                t = _to_posix(ts)
+        for r in _map_logs(_parse_node, nodes):
+            for digest, t in r["proposals"].items():
+                self.proposals[digest] = min(self.proposals.get(digest, t), t)
+            for digest, t in r["commits"].items():
                 self.commits[digest] = min(self.commits.get(digest, t), t)
-            for ts, rnd, digest, payload in _search_all(
-                rf"{_TS}.*Committed B(\d+)\((\S+?)\) -> (\S+)$", text
-            ):
-                t = _to_posix(ts)
+            for payload, (digest, t) in r["committed_payloads"].items():
                 prev = self.committed_payloads.get(payload)
                 if prev is None or t < prev[1]:
                     self.committed_payloads[payload] = (digest, t)
-            for ts, payload, size in _search_all(
-                rf"{_TS}.*Payload (\S+) contains (\d+) B", text
-            ):
-                self.payload_sizes[payload] = int(size)
-            for ts, payload, sid in _search_all(
-                rf"{_TS}.*Payload (\S+) contains sample tx (\d+)", text
-            ):
-                # Client index is unknown from node logs; samples are joined
-                # per-id against every client that sent that id (logs.py:102).
-                self.sample_to_payload[int(sid)] = payload
-            for ts, kind, n in _search_all(
-                rf"{_TS}.*Verifying (OWN|OTHER) transaction batch\. Size: (\d+)", text
-            ):
-                self.verif_batches.append((_to_posix(ts), int(n)))
-            self.timeouts += len(_search_all(r"Timeout reached", text))
+            self.payload_sizes.update(r["payload_sizes"])
+            # Client index is unknown from node logs; samples are joined
+            # per-id against every client that sent that id (logs.py:102).
+            self.sample_to_payload.update(r["sample_to_payload"])
+            self.verif_batches.extend(r["verif_batches"])
+            self.timeouts += r["timeouts"]
 
     @staticmethod
     def _parse_configs(text: str) -> dict:

@@ -150,20 +150,34 @@ class BatchVerificationService:
                 total += len(g)
                 urgent |= g.urgent
 
-            # Urgent flushes bypass the dispatch bound: when every slot is
-            # held by a large workload batch in flight, a 3-signature QC
-            # check must still dispatch immediately (backends send small
-            # batches down the CPU fast path, so unbounded urgent dispatches
-            # are bounded in practice by the consensus message rate).
-            if not urgent:
-                await self._dispatch_sem.acquire()
-            task = asyncio.get_running_loop().create_task(
-                self._dispatch(groups, total, urgent), name="verify-dispatch"
-            )
-            self._dispatches.add(task)
-            task.add_done_callback(self._dispatches.discard)
+            # Urgent groups dispatch in their OWN flush, immediately: a
+            # 3-signature QC check must neither ride a multi-thousand-
+            # signature workload batch down the device path nor wait for a
+            # dispatch slot held by one (backends send small batches down
+            # the CPU fast path, so unbounded urgent dispatches are bounded
+            # in practice by the consensus message rate). Workload groups
+            # coalesced in the same pass flush separately, gated by the
+            # dispatch bound — acquired inside _dispatch so this loop keeps
+            # draining the queue while every slot is in flight.
+            if urgent:
+                hot = [g for g in groups if g.urgent]
+                cold = [g for g in groups if not g.urgent]
+                self._spawn_dispatch(hot, sum(len(g) for g in hot), True)
+                if cold:
+                    self._spawn_dispatch(cold, sum(len(g) for g in cold), False)
+            else:
+                self._spawn_dispatch(groups, total, False)
+
+    def _spawn_dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
+        task = asyncio.get_running_loop().create_task(
+            self._dispatch(groups, total, urgent), name="verify-dispatch"
+        )
+        self._dispatches.add(task)
+        task.add_done_callback(self._dispatches.discard)
 
     async def _dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
+        if not urgent:
+            await self._dispatch_sem.acquire()
         try:
             msgs = [m for g in groups for m in g.messages]
             keys = [k for g in groups for k in g.keys]

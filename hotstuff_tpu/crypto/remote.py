@@ -94,40 +94,64 @@ class RemoteBackend(CryptoBackend):
 
     name = "remote"
 
+    # Requests below this ride the dedicated urgent lane (socket + slot),
+    # mirroring the sidecar's `urgent_below` service-side split: a
+    # consensus-critical QC check must never queue behind workload-sized
+    # transfers occupying every pooled socket.
+    URGENT_BELOW = 256
+
     def __init__(
         self,
         addr: tuple[str, int],
         crossover: int = 64,
         timeout: float = 30.0,
-        pool_size: int = 3,
+        pool_size: int = 5,
     ):
         self.addr = addr
         self.crossover = crossover
         self.timeout = timeout
         self._cpu = CpuBackend()
-        # Small connection pool: concurrent service dispatches each borrow a
+        # Connection pool: concurrent service dispatches each borrow a
         # socket, so a second batch streams into the sidecar while the first
         # is on the device (one socket would serialize the round trips).
+        # Sized above BatchVerificationService's max_concurrent_dispatches
+        # (4) so in-flight workload round trips can never exhaust it.
         self._pool: list[socket.socket] = []
         self._pool_lock = threading.Lock()
         self._pool_sem = threading.BoundedSemaphore(pool_size)
+        # Urgent lane: one reserved socket + slot for small requests.
+        self._urgent_sem = threading.BoundedSemaphore(1)
+        self._urgent_sock: socket.socket | None = None
         self.stats = {"remote_batches": 0, "remote_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
-    def _borrow(self) -> socket.socket:
-        with self._pool_lock:
-            if self._pool:
-                return self._pool.pop()
+    def _dial(self) -> socket.socket:
         s = socket.create_connection(self.addr, timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
 
-    def _give_back(self, sock: socket.socket) -> None:
+    def _borrow(self, urgent: bool) -> socket.socket:
         with self._pool_lock:
-            self._pool.append(sock)
+            if urgent:
+                if self._urgent_sock is not None:
+                    sock, self._urgent_sock = self._urgent_sock, None
+                    return sock
+            elif self._pool:
+                return self._pool.pop()
+        return self._dial()
+
+    def _give_back(self, sock: socket.socket, urgent: bool) -> None:
+        with self._pool_lock:
+            if urgent and self._urgent_sock is None:
+                self._urgent_sock = sock
+            else:
+                self._pool.append(sock)
 
     def _flush_pool(self) -> None:
         with self._pool_lock:
             stale, self._pool = self._pool, []
+            if self._urgent_sock is not None:
+                stale.append(self._urgent_sock)
+                self._urgent_sock = None
         for s in stale:
             try:
                 s.close()
@@ -157,29 +181,26 @@ class RemoteBackend(CryptoBackend):
             self.stats["cpu_sigs"] += n
             return self._cpu.verify_batch_mask(messages, keys, signatures)
         payload = _encode_request(messages, keys, signatures)
-        with self._pool_sem:  # bound concurrent round-trips to the pool size
+        urgent = n < self.URGENT_BELOW
+        sem = self._urgent_sem if urgent else self._pool_sem
+        with sem:  # bound concurrent round-trips per lane
             for attempt in (0, 1):
                 sock = None
                 try:
                     if attempt == 0:
-                        sock = self._borrow()
+                        sock = self._borrow(urgent)
                     else:
                         # Pooled sockets may ALL be stale (sidecar restart);
                         # the final attempt must dial fresh, and the rest of
                         # the suspect pool is dropped below.
                         self._flush_pool()
-                        sock = socket.create_connection(
-                            self.addr, timeout=self.timeout
-                        )
-                        sock.setsockopt(
-                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
-                        )
+                        sock = self._dial()
                     sock.sendall(payload)
                     (count,) = struct.unpack("<I", self._recv_exact(sock, 4))
                     if count != n:
                         raise ConnectionError("sidecar count mismatch")
                     mask = self._recv_exact(sock, n)
-                    self._give_back(sock)
+                    self._give_back(sock, urgent)
                     self.stats["remote_batches"] += 1
                     self.stats["remote_sigs"] += n
                     return [b != 0 for b in mask]
@@ -350,10 +371,18 @@ def main(argv: list[str] | None = None) -> None:
             from ..parallel.mesh import init_multihost
 
             mesh = init_multihost()
-            backend = make_backend(args.backend, mesh=mesh)
+            backend = make_backend(
+                args.backend, mesh=mesh, min_bucket=args.min_bucket
+            )
         else:
             backend = make_backend(args.backend, min_bucket=args.min_bucket)
     else:
+        # A sweep that silently ignored these flags would record numbers
+        # under a different config than the operator specified.
+        if args.multihost:
+            p.error("--multihost requires --backend tpu")
+        if args.min_bucket != p.get_default("min_bucket"):
+            p.error("--min-bucket requires --backend tpu")
         backend = make_backend(args.backend)
     from ..utils.logging import quiet_jax_logs
 

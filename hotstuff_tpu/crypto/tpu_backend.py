@@ -36,10 +36,16 @@ class TpuBackend(CryptoBackend):
 
         enable_persistent_cache()
         if sharded or mesh is not None:
+            import jax
+
             from ..parallel.mesh import ShardedEd25519Verifier
 
+            kernel = "w4" if jax.default_backend() == "cpu" else "pallas"
             self._verifier = ShardedEd25519Verifier(
-                mesh=mesh, max_bucket=max_bucket
+                mesh=mesh,
+                min_bucket=min_bucket,
+                max_bucket=max_bucket,
+                kernel=kernel,
             )
         else:
             import jax

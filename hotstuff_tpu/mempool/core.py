@@ -136,23 +136,17 @@ class Core:
 
     # -- benchmark workload --------------------------------------------------
 
-    def _synthetic_coro(self, kind: str, n: int):
-        """The fork's injected hot path (mempool/src/core.rs:135-148,211-224):
-        returns the verification coroutine (or None when inactive). The log
-        line here is the single source of the votes/sec metric.
+    async def _submit_synthetic_batch(self, kind: str, n: int) -> None:
+        """The fork's injected hot path (mempool/src/core.rs:135-148,211-224),
+        run as a bounded background task — multiple batches stay in flight
+        while the core keeps processing. The log line here is the single
+        source of the votes/sec metric.
         NOTE: This log entry is used to compute performance."""
         if self.pool is None or n == 0:
-            return None
+            return
         log.info("Verifying %s transaction batch. Size: %s", kind, n)
         msgs, pairs = self.pool.take(n)
-        return self._run_synthetic(msgs, pairs)
-
-    async def _submit_synthetic_batch(self, kind: str, n: int) -> None:
-        """Run the synthetic batch as a bounded background task — multiple
-        batches stay in flight while the core keeps processing."""
-        coro = self._synthetic_coro(kind, n)
-        if coro is not None:
-            await self._spawn_verification(coro)
+        await self._spawn_verification(self._run_synthetic, msgs, pairs)
 
     async def _run_synthetic(self, msgs, pairs) -> None:
         mask = await self.verification_service.verify_group(
@@ -161,18 +155,20 @@ class Core:
         if not all(mask):
             log.error("synthetic batch verification failed (backend bug?)")
 
-    async def _spawn_verification(self, coro) -> None:
-        """Run `coro` in a background task, capped at
+    async def _spawn_verification(self, fn, *args) -> None:
+        """Run `fn(*args)` in a background task, capped at
         `max_inflight_verifications` (acquiring the semaphore HERE gives
-        backpressure: the core pauses intake only when the pipeline is full)."""
+        backpressure: the core pauses intake only when the pipeline is full).
+        Deferred-call form (not a coroutine argument) so a task cancelled
+        before it first runs leaves no never-awaited coroutine behind."""
         await self._verify_sem.acquire()
-        task = spawn(self._release_after(coro), name="mempool-verify")
+        task = spawn(self._release_after(fn, *args), name="mempool-verify")
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
-    async def _release_after(self, coro) -> None:
+    async def _release_after(self, fn, *args) -> None:
         try:
-            await coro
+            await fn(*args)
         except Exception as e:  # must not kill the task group silently
             log.warning("background verification error: %r", e)
         finally:
@@ -212,7 +208,7 @@ class Core:
             payload.size() <= self.parameters.max_payload_size,
             PayloadTooBigError(payload.size(), self.parameters.max_payload_size),
         )
-        await self._spawn_verification(self._finish_others_payload(payload))
+        await self._spawn_verification(self._finish_others_payload, payload)
 
     async def _finish_others_payload(self, payload: Payload) -> None:
         ok = await payload.verify_async(self.committee, self.verification_service)
@@ -225,9 +221,14 @@ class Core:
         # outcome is measured, not consumed).
         await self._store_payload(payload)
         self._queue_insert(payload.digest())
-        coro = self._synthetic_coro("OTHER", len(payload.transactions))
-        if coro is not None:
-            await coro  # already inside a bounded background task
+        # Inline (not _submit_synthetic_batch): this coroutine already runs
+        # inside a bounded background task holding a _verify_sem slot.
+        n = len(payload.transactions)
+        if self.pool is not None and n > 0:
+            # NOTE: This log entry is used to compute performance.
+            log.info("Verifying OTHER transaction batch. Size: %s", n)
+            msgs, pairs = self.pool.take(n)
+            await self._run_synthetic(msgs, pairs)
 
     def _queue_insert(self, digest: Digest) -> None:
         if digest in self._cleaned:
@@ -269,6 +270,11 @@ class Core:
         if not payload.transactions:
             return []
         digest = await self._handle_own_payload(payload)
+        # A freshly-made payload can collide with an already-committed digest
+        # (identical tx content re-made after a cleanup): _queue_insert skips
+        # cleaned digests, and re-proposing one would double-include it.
+        if digest not in self.queue:
+            return []
         del self.queue[digest]  # it is being delivered right now
         return [digest]
 

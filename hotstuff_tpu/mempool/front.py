@@ -17,9 +17,20 @@ log = logging.getLogger("hotstuff.mempool")
 
 
 class Front:
+    """Admission control at the ingress (SURVEY §5.3): the intake queue is
+    bounded with drop-OLDEST overflow. Blocking on a full queue looks
+    gentler but is worse under sustained overload — every queued tx ages
+    while it waits, so the node spends its capacity committing stale
+    transactions nobody is waiting for anymore, and end-to-end latency
+    grows without bound. Dropping the oldest keeps the queue fresh and
+    makes throughput flat (not collapsing) past saturation."""
+
+    LOG_EVERY = 10_000  # dropped-tx log cadence
+
     def __init__(self, address: Address, deliver: asyncio.Queue) -> None:
         self._address = address
         self._deliver = deliver
+        self.dropped = 0
         spawn(self._run(), name="front")
 
     async def _run(self) -> None:
@@ -40,7 +51,22 @@ class Front:
                 break
             if tx is None:
                 break
-            await self._deliver.put(tx)
+            try:
+                self._deliver.put_nowait(tx)
+            except asyncio.QueueFull:
+                # Drop-oldest: evict the stalest queued tx for the new one.
+                try:
+                    self._deliver.get_nowait()
+                except asyncio.QueueEmpty:
+                    pass
+                self._deliver.put_nowait(tx)
+                self.dropped += 1
+                if self.dropped % self.LOG_EVERY == 1:
+                    log.warning(
+                        "front overloaded: %s transactions dropped "
+                        "(drop-oldest admission control)",
+                        self.dropped,
+                    )
         try:
             writer.close()
         except Exception:

@@ -83,6 +83,12 @@ class Mempool:
             network_tx,
             verification_service=verification_service,
         )
+        # Close the shedding loop: the payload maker stops flushing (and
+        # starts dropping txs) while the core's payload queue is full —
+        # every flush past that point would fail _queue_insert anyway.
+        payload_maker.backlog_fn = (
+            lambda: len(core.queue) >= parameters.queue_capacity
+        )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)
         return core

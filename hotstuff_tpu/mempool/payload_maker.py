@@ -37,6 +37,13 @@ class PayloadMaker:
         self._make_requests: asyncio.Queue = channel()
         self._buffer: list[Transaction] = []
         self._size = 0
+        # Load shedding (set by Mempool.run): when this returns True the
+        # mempool queue is at capacity, and flushing another payload would
+        # only burn a signature + a committee broadcast before the insert
+        # fails with QueueFullError (core.rs:131). Shed incoming txs
+        # instead, so throughput stays flat past saturation.
+        self.backlog_fn = lambda: False
+        self.shed = 0
         spawn(self._run(), name="payload-maker")
 
     async def request_make(self) -> Payload:
@@ -56,6 +63,15 @@ class PayloadMaker:
         return payload
 
     async def _ingest(self, tx: Transaction) -> None:
+        if self.backlog_fn():
+            self.shed += 1
+            if self.shed % 10_000 == 1:
+                log.warning(
+                    "payload maker shedding: %s transactions dropped "
+                    "(mempool queue at capacity)",
+                    self.shed,
+                )
+            return
         if len(tx) > self.max_payload_size:
             # A single oversized tx would flush as a payload every honest
             # peer rejects at ingress (PayloadTooBigError), leaving a

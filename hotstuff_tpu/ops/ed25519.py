@@ -502,12 +502,14 @@ def _uploader() -> "ThreadPoolExecutor":
         return _UPLOADER
 
 
-def _upload_dispatch(fn, padded: np.ndarray):
+def _upload_dispatch(fn, padded: np.ndarray, put=None):
     """Runs on the uploader thread: ship one packed chunk, dispatch the
-    kernel (async), return the device mask handle."""
+    kernel (async), return the device mask handle. `put` overrides the
+    host->device transfer (the mesh verifier shards the batch axis here,
+    so the jitted shard_map never reshards a device-0 array)."""
     import jax as _jax
 
-    return fn(_jax.device_put(padded))
+    return fn((put or _jax.device_put)(padded))
 
 
 class Ed25519TpuVerifier:
@@ -546,6 +548,7 @@ class Ed25519TpuVerifier:
         self.max_bucket = max_bucket
         self.packed = packed if packed is not None else kernel != "bits"
         self.chunk = min(chunk or 4096, max_bucket)
+        self._put = None  # optional device_put override (mesh sharding)
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -587,7 +590,9 @@ class Ed25519TpuVerifier:
             )
             width = self._bucket(hi - lo)
             futs.append(
-                up.submit(_upload_dispatch, fn, _pad(staged["packed"], width))
+                up.submit(
+                    _upload_dispatch, fn, _pad(staged["packed"], width), self._put
+                )
             )
             oks.append(staged["s_ok"])
             spans.append((lo, hi, width))

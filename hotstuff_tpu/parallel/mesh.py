@@ -156,12 +156,34 @@ def sharded_qc_verify_fn(mesh: Mesh):
     return jax.jit(mapped)
 
 
+def sharded_packed_fn(mesh: Mesh, dp_axis: str = "dp", kernel: str = "w4"):
+    """Jitted (128, B) u8 packed wire array -> (B,) bool, batch sharded on
+    `dp_axis`. Each device unpacks and verifies its shard — the SAME 6x-
+    smaller wire format and unpack-on-device recipe as the single-chip
+    packed path (`ed._verify_kernel_w4_packed128`), so the pipelined
+    uploader and bucketing machinery work unchanged over a mesh."""
+    if kernel == "pallas":
+        from ..ops.pallas_ladder import _verify_kernel_pallas_packed128 as base
+    else:
+        base = ed._verify_kernel_w4_packed128
+
+    mapped = shard_map(
+        base, mesh=mesh, in_specs=P(None, dp_axis), out_specs=P(dp_axis)
+    )
+    return jax.jit(mapped)
+
+
 class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
-    """Drop-in Ed25519TpuVerifier that shards batches over a mesh."""
+    """Drop-in Ed25519TpuVerifier that shards batches over a mesh.
+
+    Uses the packed (128 B/signature) wire format and the threaded upload
+    pipeline of the base class; chunks are device_put with an explicit
+    batch-axis NamedSharding so the transfer lands sharded (no device-0
+    staging + reshard). `packed=False` restores the f32-argument
+    `sharded_verify_fn` path (used by the legacy bit-ladder kernel)."""
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)
-        self.packed = False  # sharded path stages f32 args via _run_chunk
         self.mesh = mesh or default_mesh()
         self._ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
         # per-device shard keeps full lanes (and pallas BLOCK alignment)
@@ -176,9 +198,21 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
         # overshoots a 8192 cap that 384 does not divide).
         align = lane * self._ndev
         self.max_bucket = max(align, self.max_bucket // align * align)
-        self._fn = sharded_verify_fn(
-            self.mesh, self.mesh.axis_names[0], self.kernel
-        )
+        self.chunk = min(self.chunk, self.max_bucket)
+        dp = self.mesh.axis_names[0]
+        if self.packed:
+            from jax.sharding import NamedSharding
+
+            self._sharded_packed = sharded_packed_fn(self.mesh, dp, self.kernel)
+            self._put = functools.partial(
+                jax.device_put,
+                device=NamedSharding(self.mesh, P(None, dp)),
+            )
+        else:
+            self._fn = sharded_verify_fn(self.mesh, dp, self.kernel)
+
+    def _packed_fn(self):
+        return self._sharded_packed
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)

@@ -54,11 +54,22 @@ class Selector:
     are (name, factory) where factory() returns a fresh awaitable.
     """
 
-    def __init__(self) -> None:
+    # A ready branch that loses this many consecutive selections is served
+    # regardless of priority. Priorities express TIE-BREAKS (who wins a
+    # same-instant race), not precedence: without the bound, a flooded
+    # higher-priority source (e.g. a peer spraying cheap SyncRequests)
+    # starves the pacemaker branch indefinitely — strictly weaker liveness
+    # than the reference's randomized select!, which serves any ready branch
+    # with p >= 1/2 per iteration.
+    STARVATION_BOUND = 8
+
+    def __init__(self, starvation_bound: int = STARVATION_BOUND) -> None:
         self._factories: dict[str, Any] = {}
         self._pending: dict[str, asyncio.Task] = {}
         self._priority: dict[str, int] = {}
         self._last: str | None = None  # round-robin fairness cursor
+        self._starvation_bound = starvation_bound
+        self._deferred: dict[str, int] = {}  # consecutive ready-but-passed
 
     def add(self, name: str, factory, priority: int = 0) -> None:
         """Register a branch. Lower `priority` wins ties (same-instant
@@ -73,6 +84,7 @@ class Selector:
     def remove(self, name: str) -> None:
         self._factories.pop(name, None)
         self._priority.pop(name, None)
+        self._deferred.pop(name, None)
         task = self._pending.pop(name, None)
         if task is not None:
             task.cancel()
@@ -113,12 +125,25 @@ class Selector:
                     next(it) if self._priority.get(n, 0) == prio else n
                     for n in names
                 ]
-            for name in names:
-                task = self._pending.get(name)
-                if task is not None and task.done() and task in done:
-                    del self._pending[name]
-                    self._last = name
-                    return name, task.result()
+            ready = [
+                n
+                for n in names
+                if (t := self._pending.get(n)) is not None and t.done()
+            ]
+            if not ready:
+                continue
+            winner = ready[0]
+            # Bounded deferral: branches passed over while ready accumulate a
+            # loss count; one that reaches the bound is served now. At most
+            # one branch can cross the bound per call (counts reset on win).
+            for n in ready[1:]:
+                self._deferred[n] = self._deferred.get(n, 0) + 1
+                if self._deferred[n] >= self._starvation_bound:
+                    winner = n
+            self._deferred.pop(winner, None)
+            task = self._pending.pop(winner)
+            self._last = winner
+            return winner, task.result()
 
     def close(self) -> None:
         for task in self._pending.values():

@@ -102,3 +102,59 @@ def test_plot_families(results_dir):
         "tps-vs-committee.pdf",
         "robustness.pdf",
     }
+
+
+# ---------------------------------------------------------------------------
+# LogParser: synthetic log scraping + crash scan (reference logs.py:27-39,71,88)
+
+
+CLIENT_LOG = """\
+[2026-07-30T10:00:00.000Z INFO hotstuff.client] Transactions size: 512 B
+[2026-07-30T10:00:00.001Z INFO hotstuff.client] Transactions rate: 1000 tx/s
+[2026-07-30T10:00:00.002Z INFO hotstuff.client] Start sending transactions
+[2026-07-30T10:00:00.100Z INFO hotstuff.client] Sending sample transaction 0
+[2026-07-30T10:00:01.100Z INFO hotstuff.client] Sending sample transaction 1
+"""
+
+NODE_LOG = """\
+[2026-07-30T10:00:00.000Z INFO hotstuff.node] Timeout delay set to 5000 ms
+[2026-07-30T10:00:00.200Z INFO hotstuff.mempool] Payload abc= contains 1024 B
+[2026-07-30T10:00:00.201Z INFO hotstuff.mempool] Payload abc= contains sample tx 0
+[2026-07-30T10:00:00.300Z INFO hotstuff.consensus] Created B1(b1=)
+[2026-07-30T10:00:00.900Z INFO hotstuff.consensus] Committed B1(b1=)
+[2026-07-30T10:00:00.901Z INFO hotstuff.consensus] Committed B1(b1=) -> abc=
+[2026-07-30T10:00:01.000Z INFO hotstuff.mempool] Verifying OWN transaction batch. Size: 500
+[2026-07-30T10:00:02.000Z INFO hotstuff.mempool] Verifying OTHER transaction batch. Size: 700
+"""
+
+
+def test_log_parser_metrics():
+    from benchmark.logs import LogParser
+
+    p = LogParser([CLIENT_LOG], [NODE_LOG])
+    assert p.size == 512 and p.rate == 1000
+    tps, bps, _ = p.consensus_throughput()
+    assert bps > 0 and tps == pytest.approx(bps / 512)
+    assert p.consensus_latency() == pytest.approx(0.6)
+    # sample 0 sent at t=0.100, payload committed at t=0.901
+    assert p.end_to_end_latency() == pytest.approx(0.801)
+    rate, total = p.verification_throughput()
+    assert total == 1200 and rate == pytest.approx(1200.0)
+    assert "Consensus TPS" in p.result()
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "[...] Traceback (most recent call last):\n",
+        "[2026-07-30T10:00:03.000Z ERROR hotstuff.consensus] consensus core error: boom\n",
+        "actor mempool-verify crashed: RuntimeError()\n",
+    ],
+)
+def test_log_parser_raises_on_crash_lines(bad):
+    from benchmark.logs import LogParser, ParseError
+
+    with pytest.raises(ParseError):
+        LogParser([CLIENT_LOG], [NODE_LOG + bad])
+    with pytest.raises(ParseError):
+        LogParser([CLIENT_LOG + bad], [NODE_LOG])

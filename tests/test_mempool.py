@@ -170,3 +170,97 @@ def test_payload_request_served(run_async, base_port):
         raise AssertionError("requested payload never delivered")
 
     run_async(body())
+
+
+def test_front_drop_oldest_admission_control(run_async, base_port):
+    """Overload: a full intake queue evicts the OLDEST tx for the newest
+    (bounded, fresh) instead of blocking the reader (unbounded latency)."""
+
+    async def body():
+        from hotstuff_tpu.mempool.front import Front
+
+        q = channel(3)
+        port = base_port + 70
+        front = Front(("127.0.0.1", port), q)
+        await asyncio.sleep(0.05)  # listener up
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        for i in range(10):
+            w.write(frame(bytes([i]) * 12))
+        await w.drain()
+        for _ in range(100):
+            if front.dropped >= 7:
+                break
+            await asyncio.sleep(0.01)
+        assert front.dropped == 7
+        assert q.qsize() == 3
+        kept = [q.get_nowait()[0] for _ in range(3)]
+        assert kept == [7, 8, 9], "queue must hold the newest transactions"
+        w.close()
+
+    run_async(body())
+
+
+def test_payload_maker_sheds_on_backlog(run_async):
+    """With the mempool queue at capacity, incoming txs are shed before
+    buffering — no signature burn, no payload flush."""
+
+    async def body():
+        from hotstuff_tpu.mempool.payload_maker import PayloadMaker
+
+        pk, sk = keys()[0]
+        tx_in, core_ch = channel(), channel()
+        maker = PayloadMaker(pk, SignatureService(sk), 64, 0, tx_in, core_ch)
+        maker.backlog_fn = lambda: True
+        for _ in range(5):
+            await tx_in.put(b"\x01" + bytes(40))
+        await asyncio.sleep(0.05)
+        assert maker.shed == 5
+        assert maker._buffer == [] and core_ch.empty()
+        # Backlog clears -> intake resumes and payloads flush again.
+        maker.backlog_fn = lambda: False
+        for _ in range(2):
+            await tx_in.put(b"\x01" + bytes(40))
+        payload = (await asyncio.wait_for(core_ch.get(), 1.0)).payload
+        assert len(payload.transactions) >= 1
+
+    run_async(body())
+
+
+def test_others_payload_runs_synthetic_workload(run_async, base_port, caplog):
+    """A foreign payload must trigger the OTHER synthetic verification
+    batch (the fork's core.rs:211-224 workload) — its log line is the
+    votes/sec metric source."""
+    import logging
+
+    async def body():
+        n = 4
+        cmt = mempool_committee(base_port, n)
+        params = MempoolParameters(
+            max_payload_size=64,
+            min_block_delay=10,
+            benchmark_mode=True,
+            synthetic_pool_size=64,
+        )
+        for pk, sk in keys(n):
+            Mempool.run(pk, cmt, params, Store(), SignatureService(sk), channel(), channel())
+        await asyncio.sleep(0.1)
+        _, w = await asyncio.open_connection("127.0.0.1", base_port + 0)
+        for _ in range(5):
+            w.write(frame(b"\x01" + bytes(60)))
+        await w.drain()
+        for _ in range(100):
+            await asyncio.sleep(0.05)
+            if any(
+                "Verifying OTHER transaction batch" in r.message
+                for r in caplog.records
+            ):
+                break
+        else:
+            raise AssertionError("OTHER synthetic batch never ran")
+        assert any(
+            "Verifying OWN transaction batch" in r.message
+            for r in caplog.records
+        )
+
+    with caplog.at_level(logging.INFO, logger="hotstuff.mempool"):
+        run_async(body())

@@ -208,6 +208,32 @@ class TestSelectorFairness:
 
         run_async(body())
 
+    def test_starved_priority_branch_served_within_bound(self, run_async):
+        """A continuously-ready priority-0 flood must not defer a ready
+        priority-1 branch forever (a peer spraying cheap SyncRequests would
+        otherwise suppress the pacemaker indefinitely): after at most
+        STARVATION_BOUND consecutive losses the deferred branch is served."""
+        from hotstuff_tpu.utils.actors import Selector, channel
+
+        async def body():
+            msg, timer = channel(), channel()
+            sel = Selector()
+            sel.add("message", msg.get)
+            sel.add("timer", timer.get, priority=1)
+            await timer.put("T")
+            for _ in range(sel.STARVATION_BOUND + 5):
+                await msg.put("M")
+            await asyncio.sleep(0.01)  # both branches armed + done
+            order = [
+                (await sel.next())[0]
+                for _ in range(sel.STARVATION_BOUND + 2)
+            ]
+            assert "timer" in order, f"timer starved: {order}"
+            # ...but it still loses the first STARVATION_BOUND - 1 ties.
+            assert order.index("timer") >= sel.STARVATION_BOUND - 1, order
+
+        run_async(body())
+
     def test_priority_branch_loses_ties(self, run_async):
         """A priority-1 branch (the pacemaker pattern) must lose ties to
         priority-0 branches even when both are continuously ready."""

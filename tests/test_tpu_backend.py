@@ -92,9 +92,42 @@ class TestShardedVerifier:
         msgs, pks, sigs = _signed_batch(16)
         sigs[3] = bytes(64)
         v = ShardedEd25519Verifier(mesh=default_mesh(8))
+        assert v.packed  # mesh path ships the 128 B/sig wire format
         mask = v.verify_batch_mask(msgs, pks, sigs)
         want = [True] * 16
         want[3] = False
+        assert mask.tolist() == want
+
+    def test_sharded_f32_path_matches(self):
+        """packed=False restores the f32-argument sharded path."""
+        from hotstuff_tpu.parallel import ShardedEd25519Verifier, default_mesh
+
+        from __graft_entry__ import _signed_batch
+
+        msgs, pks, sigs = _signed_batch(10, seed=5)
+        sigs[7] = sigs[0]
+        v = ShardedEd25519Verifier(mesh=default_mesh(8), packed=False)
+        mask = v.verify_batch_mask(msgs, pks, sigs)
+        want = [True] * 10
+        want[7] = False
+        assert mask.tolist() == want
+
+    def test_sharded_multi_chunk_pipeline(self):
+        """Oversize batches split at `chunk` and ride the threaded upload
+        pipeline with sharded device_put per chunk."""
+        from hotstuff_tpu.parallel import ShardedEd25519Verifier, default_mesh
+
+        from __graft_entry__ import _signed_batch
+
+        msgs, pks, sigs = _signed_batch(24, seed=6)
+        sigs[13] = bytes(64)
+        v = ShardedEd25519Verifier(
+            mesh=default_mesh(4), min_bucket=128, max_bucket=4096
+        )
+        v.chunk = 8  # force 3 pipelined chunks
+        mask = v.verify_batch_mask(msgs, pks, sigs)
+        want = [True] * 24
+        want[13] = False
         assert mask.tolist() == want
 
 

@@ -1,0 +1,136 @@
+"""Decompose the end-to-end TPU verification pipeline into phases.
+
+The round-3 standing was 85k sigs/s on resident data vs 40k end-to-end —
+a 2.1x pipeline loss that was asserted ("tunneled link") but never
+measured. This profiler times each phase of `Ed25519TpuVerifier`'s packed
+path in isolation and then the assembled pipeline, so the dominant term is
+a number, not a guess:
+
+  stage     C++ packed staging (prepare_batch_packed) per chunk
+  upload    jax.device_put of the padded (128, W) u8 wire array
+  dispatch  kernel call on a resident array (async issue cost)
+  compute   device execution (dispatch + block on result)
+  readback  device->host fetch of the (W,) bool mask
+  e2e       the real verify_batch_mask loop
+
+Usage:  python tools/profile_e2e.py [--batch 16384] [--chunk 4096]
+Writes a human table to stdout; commit the output to data/profiles/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import statistics
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+
+def _t(fn, reps: int = 5) -> list[float]:
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def _fmt(name: str, times: list[float], n_items: int | None = None) -> str:
+    med = statistics.median(times)
+    rate = f"{n_items / med:>12,.0f}/s" if n_items else " " * 14
+    return (
+        f"{name:<28} med {med * 1e3:>8.2f} ms  min {min(times) * 1e3:>8.2f} ms"
+        f"  {rate}"
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16384)
+    ap.add_argument("--chunk", type=int, default=4096)
+    ap.add_argument("--kernel", default="pallas", choices=["w4", "pallas"])
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from hotstuff_tpu.ops import enable_persistent_cache
+    from hotstuff_tpu.ops import ed25519 as ed
+
+    enable_persistent_cache()
+    from __graft_entry__ import _signed_batch
+
+    print(f"# devices: {jax.devices()}")
+    msgs, pks, sigs = _signed_batch(args.batch)
+    cm, ck, cs = msgs[: args.chunk], pks[: args.chunk], sigs[: args.chunk]
+    n, c = args.batch, args.chunk
+
+    verifier = ed.Ed25519TpuVerifier(
+        max_bucket=8192, kernel=args.kernel, chunk=c
+    )
+    fn = verifier._packed_fn()
+
+    # warm: compile both widths, prime staging lib
+    assert verifier.verify_batch_mask(msgs, pks, sigs).all()
+
+    # --- phase timings -----------------------------------------------------
+    rows = []
+
+    staged = ed.prepare_batch_packed(cm, ck, cs)
+    rows.append(
+        _fmt(
+            "stage (C++ packed)",
+            _t(lambda: ed.prepare_batch_packed(cm, ck, cs), args.reps),
+            c,
+        )
+    )
+    rows.append(
+        _fmt(
+            "stage (python fallback)",
+            _t(
+                lambda: ed.prepare_batch_packed(cm, ck, cs, allow_native=False),
+                2,
+            ),
+            c,
+        )
+    )
+
+    padded = ed._pad(staged["packed"], verifier._bucket(c))
+
+    def upload():
+        jax.device_put(padded).block_until_ready()
+
+    rows.append(_fmt(f"upload ({padded.nbytes} B)", _t(upload, args.reps), c))
+    mb = padded.nbytes / 1e6
+    up_med = statistics.median(_t(upload, args.reps))
+    rows.append(f"{'  -> link bandwidth':<28} {mb / up_med:>8.1f} MB/s")
+
+    dev = jax.device_put(padded)
+    rows.append(_fmt("dispatch (async issue)", _t(lambda: fn(dev), 3), None))
+
+    def compute():
+        np.asarray(fn(dev))
+
+    rows.append(_fmt("compute (resident)", _t(compute, args.reps), c))
+
+    mask = fn(dev)
+    rows.append(
+        _fmt("readback ((W,) bool)", _t(lambda: np.asarray(mask), args.reps))
+    )
+
+    def e2e():
+        verifier.verify_batch_mask(msgs, pks, sigs)
+
+    rows.append(_fmt(f"e2e ({n} in {c}-chunks)", _t(e2e, args.reps), n))
+
+    per_chunk = n // c
+    print(f"# batch={n} chunk={c} chunks={per_chunk} kernel={args.kernel}")
+    for r in rows:
+        print(r)
+
+
+if __name__ == "__main__":
+    main()
