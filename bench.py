@@ -105,15 +105,26 @@ def bench_device(msgs, pks, sigs, iters: int, kernel: str = "pallas") -> float:
     return n * iters / (time.perf_counter() - t0)
 
 
-def bench_e2e(msgs, pks, sigs, kernel: str, chunk: int, iters: int) -> float:
-    """Full path: C++ packed staging -> threaded upload pipeline -> kernel
-    -> one mask readback (what QC/payload verification actually pays)."""
+def bench_e2e(
+    msgs, pks, sigs, kernel: str, chunk: int, iters: int, mesh: bool = False
+) -> float:
+    """Full path: packed staging (device-side hashing for 32-B digests) ->
+    threaded upload pipeline -> kernel -> one mask readback (what
+    QC/payload verification actually pays). With `mesh`, batches shard
+    over every attached device (ShardedEd25519Verifier)."""
     from hotstuff_tpu.ops import ed25519 as ed
 
     n = len(msgs)
-    verifier = ed.Ed25519TpuVerifier(
-        max_bucket=8192, kernel=kernel, chunk=chunk
-    )
+    if mesh:
+        from hotstuff_tpu.parallel.mesh import ShardedEd25519Verifier
+
+        verifier = ShardedEd25519Verifier(
+            max_bucket=8192, kernel=kernel, chunk=chunk
+        )
+    else:
+        verifier = ed.Ed25519TpuVerifier(
+            max_bucket=8192, kernel=kernel, chunk=chunk
+        )
     if not verifier.verify_batch_mask(msgs, pks, sigs).all():  # compile gate
         raise RuntimeError("benchmark batch must fully verify")
     t0 = time.perf_counter()
@@ -184,6 +195,15 @@ def main() -> None:
         help="print the votes/sec vs committee-size table instead of the "
         "driver JSON line",
     )
+    ap.add_argument(
+        "--mesh",
+        action="store_true",
+        help="shard e2e verification over every attached device "
+        "(ShardedEd25519Verifier packed path); on a 1-chip host this "
+        "measures the mesh machinery's overhead, on CPU set "
+        "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_"
+        "count=8 for a correctness run",
+    )
     args = ap.parse_args()
 
     from hotstuff_tpu.ops import enable_persistent_cache
@@ -212,11 +232,15 @@ def main() -> None:
     device_rate = bench_device(
         msgs[:dn], pks[:dn], sigs[:dn], args.iters, args.kernel
     )
-    e2e_rate = bench_e2e(msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters)
+    e2e_rate = bench_e2e(
+        msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters,
+        mesh=args.mesh,
+    )
     print(
         f"# tpu kernel: {device_rate:,.0f} sigs/s device (batch={dn}), "
         f"{e2e_rate:,.0f} sigs/s end-to-end "
-        f"(batch={args.batch}, pipelined chunk={args.chunk})",
+        f"(batch={args.batch}, pipelined chunk={args.chunk}"
+        f"{', mesh' if args.mesh else ''})",
         file=sys.stderr,
     )
 

@@ -133,6 +133,30 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
 
+    # HOTSTUFF_PROFILE=<path>: run the node under cProfile and dump stats
+    # to <path>.<pid> on SIGTERM/exit (SURVEY §5.5 observability; used by
+    # the protocol-plane ceiling analysis in data/profiles/).
+    profile_path = None
+    import os
+
+    if args.command == "run" and os.environ.get("HOTSTUFF_PROFILE"):
+        import cProfile
+
+        profile_path = f"{os.environ['HOTSTUFF_PROFILE']}.{os.getpid()}"
+        profiler = cProfile.Profile()
+        profiler.enable()
+
+        import atexit
+        import signal
+
+        def _dump(*_a):
+            profiler.disable()
+            profiler.dump_stats(profile_path)
+            os._exit(0)
+
+        signal.signal(signal.SIGTERM, _dump)
+        atexit.register(lambda: profiler.dump_stats(profile_path))
+
     if args.command == "keys":
         _cmd_keys(args)
     elif args.command == "run":

@@ -271,15 +271,32 @@ def _device_nibbles(b: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack((lo, hi), axis=1).reshape(2 * b.shape[0], b.shape[1])
 
 
-def unpack_packed_inputs(a_bytes, r_bytes, s_bytes, h_bytes):
-    """u8 (32, B) wire arrays -> the standard f32 kernel arguments."""
+def _unpack_ars(a_bytes, r_bytes, s_bytes):
+    """u8 (32, B) A/R/S wire rows -> (a_y, a_sign, r_enc, s_digits)."""
     top = a_bytes[31]
     a_y = a_bytes.astype(jnp.float32).at[31].set(
         (top & 0x7F).astype(jnp.float32)
     )
     a_sign = (top >> 7).astype(jnp.float32)
     r_enc = r_bytes.astype(jnp.float32)
-    return a_y, a_sign, r_enc, _device_nibbles(s_bytes), _device_nibbles(h_bytes)
+    return a_y, a_sign, r_enc, _device_nibbles(s_bytes)
+
+
+def unpack_packed_inputs(a_bytes, r_bytes, s_bytes, h_bytes):
+    """u8 (32, B) wire arrays -> the standard f32 kernel arguments."""
+    return *_unpack_ars(a_bytes, r_bytes, s_bytes), _device_nibbles(h_bytes)
+
+
+def unpack_packed_inputs_dh(packed):
+    """(128, B) device-hash wire array (rows 96-127 = 32-byte message) ->
+    the standard f32 kernel arguments, with h = SHA-512(R||A||M) mod L
+    computed on device (ops.sha512)."""
+    from . import sha512
+
+    a_b, r_b, s_b, m_b = split_packed128(packed)
+    return *_unpack_ars(a_b, r_b, s_b), sha512.h_digits_on_device(
+        r_b, a_b, m_b
+    )
 
 
 def _verify_kernel_w4_packed(a_bytes, r_bytes, s_bytes, h_bytes):
@@ -293,6 +310,15 @@ def split_packed128(packed: jnp.ndarray) -> tuple:
 
 def _verify_kernel_w4_packed128(packed):
     return _verify_kernel_w4(*unpack_packed_inputs(*split_packed128(packed)))
+
+
+def _verify_kernel_w4_packed128_dh(packed):
+    """Device-hash variant: rows 96-127 carry the 32-byte MESSAGE instead
+    of a host-computed h; the device computes h = SHA-512(R||A||M) mod L
+    itself (ops.sha512), so host staging is reduced to byte concatenation.
+    Only valid for 32-byte messages — the protocol's hot path (votes, QCs
+    and payloads all sign digests; messages.py `Vote.digest`)."""
+    return _verify_kernel_w4(*unpack_packed_inputs_dh(packed))
 
 
 def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
@@ -367,6 +393,7 @@ _verify_jit = jax.jit(_verify_kernel)
 _verify_w4_jit = jax.jit(_verify_kernel_w4)
 _verify_w4p_jit = jax.jit(_verify_kernel_w4_packed)
 _verify_w4p128_jit = jax.jit(_verify_kernel_w4_packed128)
+_verify_w4p128dh_jit = jax.jit(_verify_kernel_w4_packed128_dh)
 
 
 # ---------------------------------------------------------------------------
@@ -448,6 +475,40 @@ def prepare_batch_packed(
     s_ok, h_bytes = _stage_scalars(messages, a, r, s)
     packed = np.ascontiguousarray(np.vstack([a.T, r.T, s.T, h_bytes.T]))
     return dict(packed=packed, s_ok=s_ok)
+
+
+_L_BE = np.frombuffer(L_ORDER.to_bytes(32, "big"), np.uint8)
+
+
+def _s_canonical_mask(s: np.ndarray) -> np.ndarray:
+    """(B, 32) little-endian s rows -> (B,) bool s < L, vectorized (no
+    per-item Python bigint loop)."""
+    diff = s[:, ::-1].astype(np.int16) - _L_BE.astype(np.int16)
+    nz = diff != 0
+    first = nz.argmax(axis=1)
+    return nz.any(axis=1) & (diff[np.arange(len(s)), first] < 0)
+
+
+def prepare_batch_packed_dh(
+    messages: Sequence[bytes],
+    keys: Sequence[bytes],
+    signatures: Sequence[bytes],
+) -> dict:
+    """Device-hash staging: dict(packed=(128, B) u8, s_ok=(B,) bool).
+
+    Rows 0-31 = A, 32-63 = R, 64-95 = S, 96-127 = the 32-byte MESSAGE —
+    h = SHA-512(R||A||M) mod L is computed ON DEVICE (ops.sha512), so the
+    host does no per-item hashing at all: staging is numpy concatenation
+    plus a vectorized s < L check. Requires every message to be exactly
+    32 bytes (the protocol signs digests; `Ed25519TpuVerifier` falls back
+    to `prepare_batch_packed` otherwise)."""
+    n = len(messages)
+    a = np.frombuffer(b"".join(keys), np.uint8).reshape(n, 32)
+    sig = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
+    m = np.frombuffer(b"".join(messages), np.uint8).reshape(n, 32)
+    r, s = sig[:, :32], sig[:, 32:]
+    packed = np.ascontiguousarray(np.vstack([a.T, r.T, s.T, m.T]))
+    return dict(packed=packed, s_ok=_s_canonical_mask(s))
 
 
 def _stage_scalars(messages, a, r, s) -> tuple[np.ndarray, np.ndarray]:
@@ -563,6 +624,13 @@ class Ed25519TpuVerifier:
             return pallas_ladder._verify_pallas_p128_jit
         return _verify_w4p128_jit
 
+    def _packed_dh_fn(self):
+        if self.kernel == "pallas":
+            from . import pallas_ladder
+
+            return pallas_ladder._verify_pallas_p128dh_jit
+        return _verify_w4p128dh_jit
+
     def verify_batch_mask(
         self,
         messages: Sequence[bytes],
@@ -580,12 +648,17 @@ class Ed25519TpuVerifier:
                     messages[lo:hi], keys[lo:hi], signatures[lo:hi]
                 )
             return out
-        fn = self._packed_fn()
+        # Device-hash fast path: when every message is a 32-byte digest
+        # (the protocol hot path), h is computed on device and host
+        # staging is pure byte concatenation.
+        device_hash = all(len(m) == 32 for m in messages)
+        fn = self._packed_dh_fn() if device_hash else self._packed_fn()
+        stage = prepare_batch_packed_dh if device_hash else prepare_batch_packed
         up = _uploader()
         futs, oks, spans = [], [], []
         for lo in range(0, n, self.chunk):
             hi = min(lo + self.chunk, n)
-            staged = prepare_batch_packed(
+            staged = stage(
                 messages[lo:hi], keys[lo:hi], signatures[lo:hi]
             )
             width = self._bucket(hi - lo)

@@ -168,4 +168,11 @@ def _verify_kernel_pallas_packed128(packed):
     )
 
 
+def _verify_kernel_pallas_packed128_dh(packed):
+    """Device-hash wire format: rows 96-127 are the 32-byte message; h is
+    computed on device (ops.sha512) in plain jnp around the pallas ladder."""
+    return _verify_kernel_pallas(*ed.unpack_packed_inputs_dh(packed))
+
+
 _verify_pallas_p128_jit = jax.jit(_verify_kernel_pallas_packed128)
+_verify_pallas_p128dh_jit = jax.jit(_verify_kernel_pallas_packed128_dh)

@@ -1,0 +1,450 @@
+"""Batched SHA-512(R || A || M) mod L on TPU — device-side scalar staging.
+
+The verification equation needs h = SHA-512(R || A || M) mod L per item
+(crypto/src/lib.rs:209-220 computes this on the CPU inside ed25519_dalek).
+Host-side hashing is serial per-item byte work — on a small host it is the
+one stage of the packed pipeline that cannot overlap with device compute
+(`ops/ed25519._stage_scalars`, `native/staging.cpp`). The protocol's hot
+path only ever signs 32-byte digests (votes/QCs sign `Block::digest`,
+payloads sign `Payload::make_digest`), so the hash input is a FIXED
+96-byte message = exactly one padded SHA-512 block; this module computes
+the whole thing batched on device:
+
+  * SHA-512: 64-bit words as (hi, lo) uint32 pairs on the VPU (TPUs have
+    no native u64); 80 rounds fully unrolled at trace time; (B,)-shaped
+    lanes so the batch rides the vector unit.
+  * mod L: radix-256 f32 limb folds reusing the exact-f32 discipline of
+    `ops.field` — 2^256 ≡ -16c and 2^252 ≡ -c (mod L) with c = L - 2^252,
+    nonnegative limbs via precomputed multiple-of-L biases, then two
+    exact conditional subtractions of L.
+
+Output is bit-exact with the host path (hashlib + Python bigint mod) for
+every input — consensus safety requires all replicas, CPU or TPU, to
+accept exactly the same signature set.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import field as f
+
+L = 2**252 + 27742317777372353535851937790883648493
+C = L - 2**252  # 2^252 ≡ -C (mod L)
+
+# --- round constants (FIPS 180-4: frac of cube/square roots of primes) -----
+
+
+def _primes(n: int) -> list[int]:
+    out, k = [], 2
+    while len(out) < n:
+        if all(k % p for p in out):
+            out.append(k)
+        k += 1
+    return out
+
+
+def _icbrt(x: int) -> int:
+    r = 1 << ((x.bit_length() + 2) // 3)
+    while True:
+        nr = (2 * r + x // (r * r)) // 3
+        if nr >= r:
+            break
+        r = nr
+    while (r + 1) ** 3 <= x:
+        r += 1
+    return r
+
+
+K64 = [_icbrt(p << 192) & (2**64 - 1) for p in _primes(80)]
+H0 = [math.isqrt(p << 128) & (2**64 - 1) for p in _primes(8)]
+
+# --- 64-bit ops on (hi, lo) uint32 pairs -----------------------------------
+
+U32 = jnp.uint32
+
+
+def _add64(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(U32)
+    return a[0] + b[0] + carry, lo
+
+
+def _add64_many(*xs):
+    acc = xs[0]
+    for x in xs[1:]:
+        acc = _add64(acc, x)
+    return acc
+
+
+def _xor64(a, b):
+    return a[0] ^ b[0], a[1] ^ b[1]
+
+
+def _rotr64(x, n: int):
+    hi, lo = x
+    if n == 32:
+        return lo, hi
+    if n < 32:
+        return (
+            (hi >> n) | (lo << (32 - n)),
+            (lo >> n) | (hi << (32 - n)),
+        )
+    m = n - 32
+    return (
+        (lo >> m) | (hi << (32 - m)),
+        (hi >> m) | (lo << (32 - m)),
+    )
+
+
+def _shr64(x, n: int):
+    hi, lo = x
+    if n < 32:
+        return hi >> n, (lo >> n) | (hi << (32 - n))
+    return jnp.zeros_like(hi), hi >> (n - 32)
+
+
+def _big_sigma0(x):
+    return _xor64(_xor64(_rotr64(x, 28), _rotr64(x, 34)), _rotr64(x, 39))
+
+
+def _big_sigma1(x):
+    return _xor64(_xor64(_rotr64(x, 14), _rotr64(x, 18)), _rotr64(x, 41))
+
+
+def _small_sigma0(x):
+    return _xor64(_xor64(_rotr64(x, 1), _rotr64(x, 8)), _shr64(x, 7))
+
+
+def _small_sigma1(x):
+    return _xor64(_xor64(_rotr64(x, 19), _rotr64(x, 61)), _shr64(x, 6))
+
+
+def _ch(e, fv, g):
+    return (
+        (e[0] & fv[0]) ^ (~e[0] & g[0]),
+        (e[1] & fv[1]) ^ (~e[1] & g[1]),
+    )
+
+
+def _maj(a, b, c):
+    return (
+        (a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+        (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]),
+    )
+
+
+def _const64(v: int, batch: int):
+    hi = jnp.full((batch,), (v >> 32) & 0xFFFFFFFF, U32)
+    lo = jnp.full((batch,), v & 0xFFFFFFFF, U32)
+    return hi, lo
+
+
+def sha512_96(r_bytes, a_bytes, m_bytes):
+    """SHA-512 of the 96-byte message R||A||M, batched.
+
+    Inputs: three (32, B) u8 arrays. Output: (64, B) f32 little-endian
+    radix-256 limbs of the digest interpreted as an integer (RFC 8032
+    digest-to-scalar convention), ready for `reduce_mod_l`.
+    """
+    batch = r_bytes.shape[1]
+    msg = jnp.concatenate([r_bytes, a_bytes, m_bytes], axis=0)  # (96, B)
+    u = msg.astype(U32)
+
+    # One padded block: 96 message bytes, 0x80, zeros, 128-bit length (768).
+    def word(j):  # big-endian 64-bit word j of the padded block
+        base = 8 * j
+        if base + 8 <= 96:
+            hi = (
+                (u[base] << 24)
+                | (u[base + 1] << 16)
+                | (u[base + 2] << 8)
+                | u[base + 3]
+            )
+            lo = (
+                (u[base + 4] << 24)
+                | (u[base + 5] << 16)
+                | (u[base + 6] << 8)
+                | u[base + 7]
+            )
+            return hi, lo
+        if j == 12:  # bytes 96-103: 0x80 then zeros
+            return _const64(0x8000000000000000, batch)
+        if j == 15:  # length in bits, big-endian: 96*8 = 768
+            return _const64(768, batch)
+        return _const64(0, batch)
+
+    # Rolling-window fori_loop: W holds w[t..t+15]; round t consumes W[0]
+    # and appends w[t+16]. An unrolled 80-round trace compiles minutes-slow
+    # on XLA; the loop body traces once (~60 ops).
+    w16 = jnp.stack(
+        [jnp.stack(word(j), axis=0) for j in range(16)], axis=0
+    )  # (16, 2, B) u32
+    k_tab = jnp.array(
+        [[(k >> 32) & 0xFFFFFFFF, k & 0xFFFFFFFF] for k in K64], U32
+    )  # (80, 2)
+    state0 = jnp.broadcast_to(
+        jnp.array(
+            [[(h >> 32) & 0xFFFFFFFF, h & 0xFFFFFFFF] for h in H0], U32
+        )[:, :, None],
+        (8, 2, batch),
+    )
+
+    def pair(arr2b):  # (2, B) -> (hi, lo)
+        return arr2b[0], arr2b[1]
+
+    def round_body(t, carry):
+        state, w = carry
+        a, b, c, d = (pair(state[i]) for i in range(4))
+        e, fv, g, h = (pair(state[i]) for i in range(4, 8))
+        w_t = pair(w[0])
+        kt = lax.dynamic_index_in_dim(k_tab, t, 0, keepdims=False)
+        k_pair = (
+            jnp.broadcast_to(kt[0], (batch,)),
+            jnp.broadcast_to(kt[1], (batch,)),
+        )
+        t1 = _add64_many(h, _big_sigma1(e), _ch(e, fv, g), k_pair, w_t)
+        t2 = _add64(_big_sigma0(a), _maj(a, b, c))
+        new_a = _add64(t1, t2)
+        new_e = _add64(d, t1)
+        state = jnp.stack(
+            [
+                jnp.stack(new_a),
+                state[0],
+                state[1],
+                state[2],
+                jnp.stack(new_e),
+                state[4],
+                state[5],
+                state[6],
+            ],
+            axis=0,
+        )
+        w_new = _add64_many(
+            _small_sigma1(pair(w[14])),
+            pair(w[9]),
+            _small_sigma0(pair(w[1])),
+            w_t,
+        )
+        w = jnp.concatenate([w[1:], jnp.stack(w_new)[None]], axis=0)
+        return state, w
+
+    state, _ = lax.fori_loop(0, 80, round_body, (state0, w16))
+    digest = [
+        _add64(pair(state0[i]), pair(state[i])) for i in range(8)
+    ]
+
+    # Digest bytes (big-endian per word) -> little-endian integer limbs:
+    # limb[8j + k] = byte k of word j = (word_j >> (56 - 8k)) & 0xFF.
+    rows = []
+    for hi, lo in digest:
+        for part in (hi, lo):
+            rows.extend(
+                ((part >> sh) & 0xFF).astype(jnp.float32)
+                for sh in (24, 16, 8, 0)
+            )
+    return jnp.stack(rows, axis=0)  # (64, B) f32
+
+
+# --- mod L reduction (exact-f32 limb folds) --------------------------------
+#
+# Fold identities: 2^256 ≡ -16C, 2^252 ≡ -C (mod L). Subtractions stay
+# nonnegative by adding a precomputed multiple-of-L bias whose limbs all
+# exceed the subtrahend's normalized limb bound (field.py's BIAS16P trick,
+# generalized to L and arbitrary widths).
+
+C16_LIMBS = f.limbs_of_int(16 * C, 17)  # 16C < 2^129
+
+
+def _bias_of_l(width: int, lo: int = 768) -> np.ndarray:
+    """(width + 1, 1) f32 limbs of a multiple of L whose limbs 0..width-1
+    are all in [lo, 2^13): the per-limb lower bound lets folds subtract
+    normalized (<= 294) product limbs without borrows. The top row holds
+    the remaining mass (unconstrained below 2^13)."""
+    mult = (lo * (256**width - 1) // 255) // L + 2
+    # Any multiple of L is >= 2^252, so the representation needs at least
+    # 33 rows even when only a few leading rows carry floors.
+    rows = max(width + 1, 33)
+    assert mult * L < 256**rows
+    digits = [(mult * L >> (8 * i)) & 0xFF for i in range(rows)]
+    digits[rows - 1] += 256 * (mult * L >> (8 * rows))
+    for i in range(width):
+        while digits[i] < lo:
+            digits[i] += 256
+            digits[i + 1] -= 1
+    # Cascade borrows through the unfloored tail (its digits may be 0).
+    for i in range(width, rows - 1):
+        if digits[i] < 0:
+            k = (-digits[i] + 255) // 256
+            digits[i] += 256 * k
+            digits[i + 1] -= k
+    assert digits[rows - 1] >= 0 and all(0 <= d < 2**13 for d in digits)
+    assert sum(d << (8 * i) for i, d in enumerate(digits)) == mult * L
+    return np.array(digits, np.float32).reshape(rows, 1)
+
+
+# Fold width derivations (value bounds -> nonzero normalized limb rows):
+#   fold 1: input < 2^512 (64 limbs), hi = 32 limbs < 2^256;
+#           prod = 16C*hi < 2^385 -> rows 0..48 (49); bias width 49;
+#           out < bias_total + 2^256 < 2^395 -> 51 rows (49 + 2 headroom).
+#   fold 2: hi = rows 32..50 (19 limbs) < 2^139; prod < 2^268 -> 34 rows;
+#           bias width 34; out < 2^275 -> 36 rows.
+#   fold 3 (2^252 boundary): hi < 2^24-ish; prod = C*hi < 2^149 -> 19
+#           rows; bias width 19; out < 2^252 + 2^155 < 2L.
+BIAS_F1 = _bias_of_l(49)
+BIAS_F2 = _bias_of_l(34)
+BIAS_F3 = _bias_of_l(19)
+C_LIMBS = f.limbs_of_int(C, 16)
+L_COMPLEMENT = f.limbs_of_int(2**264 - L, 33)
+
+
+def _carry_n(c: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """Vectorized no-wrap carry passes; callers provide headroom rows.
+    Input limbs < 2^24 exact -> output limbs <= 294."""
+    for _ in range(passes):
+        c = f._carry_pass(c, wrap=False)
+    return c
+
+
+def _seq_carry_n(c: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry over ALL rows (f._seq_carry is fixed at 32);
+    returns (limbs in [0, 256), carry_out)."""
+
+    def body(i, state):
+        limbs, carry = state
+        t = lax.dynamic_index_in_dim(limbs, i, axis=0, keepdims=False) + carry
+        hi = jnp.floor(t * (1.0 / 256.0))
+        lo = t - hi * 256.0
+        return lax.dynamic_update_index_in_dim(limbs, lo, i, axis=0), hi
+
+    carry0 = jnp.zeros(c.shape[1:], c.dtype)
+    return lax.fori_loop(0, c.shape[0], body, (c, carry0))
+
+
+def _mul_const(hi_limbs: jnp.ndarray, const: np.ndarray, out_rows: int):
+    """(n, B) limbs x (k, 1) constant -> (out_rows, B) raw product limbs.
+    Exactness: limb values <= ~5000, constant limbs < 2^13 would break the
+    2^24 bound, so constants here are canonical (< 256): products <= 5000
+    * 255 < 2^21, <= k terms per row -> sums < 2^24, f32-exact."""
+    n = hi_limbs.shape[0]
+    k = const.shape[0]
+    batch = hi_limbs.shape[1:]
+    rows = []
+    for r in range(n + k - 1):
+        lo_i = max(0, r - k + 1)
+        hi_i = min(r, n - 1)
+        term = hi_limbs[lo_i] * float(const[r - lo_i, 0])
+        for i in range(lo_i + 1, hi_i + 1):
+            term = term + hi_limbs[i] * float(const[r - i, 0])
+        rows.append(jnp.broadcast_to(term, batch)[None])
+    pad = out_rows - len(rows)
+    assert pad >= 0, (out_rows, n, k)
+    if pad:
+        rows.append(jnp.zeros((pad,) + batch, jnp.float32))
+    return jnp.concatenate(rows, axis=0)
+
+
+def _fold_256(limbs: jnp.ndarray, bias: np.ndarray) -> jnp.ndarray:
+    """v = lo_32 + 2^256 * hi  ->  lo_32 + bias - 16C * hi, normalized.
+    `bias` rows must cover every nonzero row of the normalized product
+    (asserted by the width derivations above)."""
+    width = bias.shape[0]
+    batch = limbs.shape[1:]
+    lo = limbs[:32]
+    hi = limbs[32:]
+    raw = _mul_const(hi, C16_LIMBS, max(width, hi.shape[0] + 17 - 1) + 3)
+    prod = _carry_n(raw)[:width]  # rows >= width are provably zero
+    lo_w = jnp.concatenate(
+        [lo, jnp.zeros((width - 32,) + batch, jnp.float32)], axis=0
+    )
+    t = lo_w + jnp.asarray(bias) - prod
+    t = jnp.concatenate([t, jnp.zeros((2,) + batch, jnp.float32)], axis=0)
+    return _carry_n(t)
+
+
+def _fold_252(limbs: jnp.ndarray) -> jnp.ndarray:
+    """Final fold at the 2^252 boundary: result < 2L (34 rows)."""
+    batch = limbs.shape[1:]
+    width = BIAS_F3.shape[0]
+    l31 = limbs[31]
+    q = jnp.floor(l31 * (1.0 / 16.0))
+    r = l31 - 16.0 * q
+    # v = lo + 2^252 * hi with hi = q + 16*l32 + 16*l33*256 + ... — exact
+    # for ANY nonnegative limb values (no canonicality assumption).
+    tail = limbs[32:]
+    hi_rows = [q + (16.0 * tail[0] if tail.shape[0] > 0 else 0.0)]
+    for i in range(1, tail.shape[0]):
+        hi_rows.append(16.0 * tail[i])
+    hi_limbs = jnp.stack(
+        [jnp.broadcast_to(x, batch) for x in hi_rows], axis=0
+    )
+    raw = _mul_const(
+        hi_limbs, C_LIMBS, max(width, hi_limbs.shape[0] + 16 - 1) + 3
+    )
+    prod = _carry_n(raw)[:width]
+    rows = max(32, width)
+    lo_w = _pad_rows(
+        jnp.concatenate(
+            [limbs[:31], jnp.broadcast_to(r, batch)[None]], axis=0
+        ),
+        rows,
+    )
+    t = (
+        lo_w
+        + _pad_rows(jnp.asarray(BIAS_F3), rows)
+        - _pad_rows(prod, rows)
+    )
+    t = jnp.concatenate([t, jnp.zeros((2,) + batch, jnp.float32)], axis=0)
+    return _carry_n(t)
+
+
+def _pad_rows(x: jnp.ndarray, rows: int) -> jnp.ndarray:
+    if x.shape[0] >= rows:
+        return x[:rows]
+    cfg = [(0, rows - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    return jnp.pad(x, cfg)
+
+
+def _cond_sub_l(x33: jnp.ndarray) -> jnp.ndarray:
+    """One exact conditional subtraction of L on (33, B) limbs < 2^264."""
+    t = x33 + jnp.asarray(L_COMPLEMENT)
+    t, carry = _seq_carry_n(t)
+    return f.select(carry >= 1.0, t, x33)
+
+
+def reduce_mod_l(limbs64: jnp.ndarray) -> jnp.ndarray:
+    """(64, B) f32 limbs (value < 2^512) -> (32, B) canonical limbs of
+    value mod L (limbs in [0, 255], value in [0, L))."""
+    v = _fold_256(limbs64, BIAS_F1)  # < 2^395
+    v = _fold_256(v, BIAS_F2)  # < 2^275
+    # Fold-3 output < lo_max + 2L where lo_max can exceed 2^252 slightly
+    # (the low 31 limbs are normalized-but-not-canonical, <= 294 each, so
+    # their sum reaches ~2^252.01): bound is < 2^253 + 2L < 4L.
+    v = _fold_252(v)
+    v = _pad_rows(v, 33)
+    v, _ = _seq_carry_n(v)  # exact limbs before comparisons
+    v = _cond_sub_l(v)  # < 4L -> three conditional subtractions to [0, L)
+    v = _cond_sub_l(v)
+    v = _cond_sub_l(v)
+    return v[:32]
+
+
+def _nibble_rows(limbs32: jnp.ndarray) -> jnp.ndarray:
+    """(32, B) canonical byte limbs -> (64, B) 4-bit ladder digits
+    (row 2k = low nibble of limb k), matching ed25519._nibbles."""
+    hi = jnp.floor(limbs32 * (1.0 / 16.0))
+    lo = limbs32 - 16.0 * hi
+    return jnp.stack((lo, hi), axis=1).reshape(
+        2 * limbs32.shape[0], limbs32.shape[1]
+    )
+
+
+def h_digits_on_device(r_bytes, a_bytes, m_bytes) -> jnp.ndarray:
+    """(32, B) u8 x3 -> (64, B) f32 ladder digits of SHA-512(R||A||M) mod L."""
+    return _nibble_rows(reduce_mod_l(sha512_96(r_bytes, a_bytes, m_bytes)))

@@ -156,16 +156,33 @@ def sharded_qc_verify_fn(mesh: Mesh):
     return jax.jit(mapped)
 
 
-def sharded_packed_fn(mesh: Mesh, dp_axis: str = "dp", kernel: str = "w4"):
+def sharded_packed_fn(
+    mesh: Mesh,
+    dp_axis: str = "dp",
+    kernel: str = "w4",
+    device_hash: bool = False,
+):
     """Jitted (128, B) u8 packed wire array -> (B,) bool, batch sharded on
     `dp_axis`. Each device unpacks and verifies its shard — the SAME 6x-
     smaller wire format and unpack-on-device recipe as the single-chip
     packed path (`ed._verify_kernel_w4_packed128`), so the pipelined
-    uploader and bucketing machinery work unchanged over a mesh."""
+    uploader and bucketing machinery work unchanged over a mesh. With
+    `device_hash`, rows 96-127 carry 32-byte messages and each device also
+    computes h = SHA-512(R||A||M) mod L for its shard (ops.sha512)."""
     if kernel == "pallas":
-        from ..ops.pallas_ladder import _verify_kernel_pallas_packed128 as base
+        from ..ops import pallas_ladder as pl_mod
+
+        base = (
+            pl_mod._verify_kernel_pallas_packed128_dh
+            if device_hash
+            else pl_mod._verify_kernel_pallas_packed128
+        )
     else:
-        base = ed._verify_kernel_w4_packed128
+        base = (
+            ed._verify_kernel_w4_packed128_dh
+            if device_hash
+            else ed._verify_kernel_w4_packed128
+        )
 
     mapped = shard_map(
         base, mesh=mesh, in_specs=P(None, dp_axis), out_specs=P(dp_axis)
@@ -204,6 +221,9 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
             from jax.sharding import NamedSharding
 
             self._sharded_packed = sharded_packed_fn(self.mesh, dp, self.kernel)
+            self._sharded_packed_dh = sharded_packed_fn(
+                self.mesh, dp, self.kernel, device_hash=True
+            )
             self._put = functools.partial(
                 jax.device_put,
                 device=NamedSharding(self.mesh, P(None, dp)),
@@ -213,6 +233,9 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
 
     def _packed_fn(self):
         return self._sharded_packed
+
+    def _packed_dh_fn(self):
+        return self._sharded_packed_dh
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)

@@ -1,0 +1,146 @@
+"""Device-side SHA-512(R||A||M) mod L (ops/sha512.py): bit-exactness with
+the host path (hashlib + bigint mod) is a consensus-safety requirement —
+every replica, CPU or TPU, must accept exactly the same signature set
+(reference crypto/src/lib.rs:209-220 computes h inside ed25519_dalek)."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hotstuff_tpu.ops import ed25519 as ed
+from hotstuff_tpu.ops import sha512 as S
+
+RNG = random.Random(17)
+
+
+def _cols(rows_of_bytes):
+    n = len(rows_of_bytes)
+    return np.frombuffer(b"".join(rows_of_bytes), np.uint8).reshape(n, 32).T.copy()
+
+
+def test_sha512_96_matches_hashlib():
+    B = 16
+    rs = [RNG.randbytes(32) for _ in range(B)]
+    as_ = [RNG.randbytes(32) for _ in range(B)]
+    ms = [RNG.randbytes(32) for _ in range(B)]
+    # include degenerate inputs
+    rs[0] = bytes(32)
+    as_[1] = b"\xff" * 32
+    out = np.asarray(
+        jax.jit(S.sha512_96)(
+            jnp.asarray(_cols(rs)), jnp.asarray(_cols(as_)), jnp.asarray(_cols(ms))
+        )
+    )
+    for i in range(B):
+        want = hashlib.sha512(rs[i] + as_[i] + ms[i]).digest()
+        got = bytes(int(out[j, i]) for j in range(64))
+        assert got == want, f"item {i}"
+
+
+def test_reduce_mod_l_exact():
+    vals = [
+        0,
+        1,
+        S.L - 1,
+        S.L,
+        S.L + 1,
+        2 * S.L - 1,
+        2**252,
+        2**256 - 1,
+        2**512 - 1,
+        (S.L << 134) + 5,
+        (S.L << 259) - 1,  # near the 2^512 input-domain ceiling
+    ]
+    vals += [RNG.randrange(2**512) for _ in range(500)]
+    arr = np.zeros((64, len(vals)), np.float32)
+    for i, v in enumerate(vals):
+        for j in range(64):
+            arr[j, i] = (v >> (8 * j)) & 0xFF
+    red = np.asarray(jax.jit(S.reduce_mod_l)(jnp.asarray(arr)))
+    assert red.max() <= 255 and red.min() >= 0
+    for i, v in enumerate(vals):
+        got = sum(int(red[j, i]) << (8 * j) for j in range(32))
+        assert got == v % S.L, f"value index {i}"
+
+
+def test_h_digits_on_device_matches_host_staging():
+    from __graft_entry__ import _signed_batch
+
+    msgs, pks, sigs = _signed_batch(32, seed=9)
+    host = ed.prepare_batch(msgs, pks, sigs, allow_native=False)
+    r = _cols([s[:32] for s in sigs])
+    a = _cols(pks)
+    m = _cols(msgs)
+    dev = np.asarray(
+        jax.jit(S.h_digits_on_device)(
+            jnp.asarray(r), jnp.asarray(a), jnp.asarray(m)
+        )
+    )
+    np.testing.assert_array_equal(dev, host["h_digits"])
+
+
+def test_packed_dh_kernel_matches_packed():
+    """The device-hash kernel must agree with the host-hash kernel on good
+    AND adversarial items (corrupt signature, corrupt key, zero rows)."""
+    from __graft_entry__ import _signed_batch
+
+    msgs, pks, sigs = _signed_batch(8, seed=4)
+    sigs[2] = bytes(64)
+    pks[5] = bytes(31) + b"\xff"
+    sigs[6] = sigs[0]
+    staged_h = ed.prepare_batch_packed(msgs, pks, sigs, allow_native=False)
+    staged_m = ed.prepare_batch_packed_dh(msgs, pks, sigs)
+    np.testing.assert_array_equal(staged_h["s_ok"], staged_m["s_ok"])
+    want = np.asarray(ed._verify_w4p128_jit(jnp.asarray(staged_h["packed"])))
+    got = np.asarray(ed._verify_w4p128dh_jit(jnp.asarray(staged_m["packed"])))
+    np.testing.assert_array_equal(got, want)
+    assert want[0] and not want[2] and not want[5] and not want[6]
+
+
+def test_s_canonical_mask_vectorized():
+    L = ed.L_ORDER
+    cases = [0, 1, L - 1, L, L + 1, 2**256 - 1, L + 2**255]
+    cases += [RNG.randrange(2**256) for _ in range(200)]
+    s = np.zeros((len(cases), 32), np.uint8)
+    for i, v in enumerate(cases):
+        s[i] = np.frombuffer(v.to_bytes(32, "little"), np.uint8)
+    got = ed._s_canonical_mask(s)
+    want = np.array([v < L for v in cases])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_verifier_auto_selects_device_hash():
+    """32-byte messages ride the device-hash path; mixed lengths fall back
+    to host hashing — both must verify correctly."""
+    from __graft_entry__ import _signed_batch
+
+    v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
+    msgs, pks, sigs = _signed_batch(6, seed=11)
+    sigs[3] = bytes(64)
+    mask = v.verify_batch_mask(msgs, pks, sigs)
+    assert mask.tolist() == [True, True, True, False, True, True]
+
+    # non-32-byte messages: host-hash fallback
+    msgs2, pks2, sigs2 = _signed_batch(4, msg_len=100, seed=12)
+    sigs2[1] = bytes(64)
+    mask2 = v.verify_batch_mask(msgs2, pks2, sigs2)
+    assert mask2.tolist() == [True, False, True, True]
+
+
+def test_sharded_device_hash_matches(run_async=None):
+    from hotstuff_tpu.parallel import ShardedEd25519Verifier, default_mesh
+
+    from __graft_entry__ import _signed_batch
+
+    msgs, pks, sigs = _signed_batch(16, seed=13)
+    sigs[9] = sigs[1]
+    v = ShardedEd25519Verifier(mesh=default_mesh(4), kernel="w4")
+    mask = v.verify_batch_mask(msgs, pks, sigs)
+    want = [True] * 16
+    want[9] = False
+    assert mask.tolist() == want

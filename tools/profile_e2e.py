@@ -71,7 +71,13 @@ def main() -> None:
     verifier = ed.Ed25519TpuVerifier(
         max_bucket=8192, kernel=args.kernel, chunk=c
     )
-    fn = verifier._packed_fn()
+    # Phase rows must time the SAME kernel the e2e row rides: 32-byte
+    # messages auto-select the device-hash variant in verify_batch_mask.
+    device_hash = all(len(m) == 32 for m in msgs)
+    fn = verifier._packed_dh_fn() if device_hash else verifier._packed_fn()
+    stage = (
+        ed.prepare_batch_packed_dh if device_hash else ed.prepare_batch_packed
+    )
 
     # warm: compile both widths, prime staging lib
     assert verifier.verify_batch_mask(msgs, pks, sigs).all()
@@ -79,17 +85,17 @@ def main() -> None:
     # --- phase timings -----------------------------------------------------
     rows = []
 
-    staged = ed.prepare_batch_packed(cm, ck, cs)
+    staged = stage(cm, ck, cs)
     rows.append(
         _fmt(
-            "stage (C++ packed)",
+            "stage (host-hash C++)",
             _t(lambda: ed.prepare_batch_packed(cm, ck, cs), args.reps),
             c,
         )
     )
     rows.append(
         _fmt(
-            "stage (python fallback)",
+            "stage (host-hash python)",
             _t(
                 lambda: ed.prepare_batch_packed(cm, ck, cs, allow_native=False),
                 2,
@@ -97,6 +103,14 @@ def main() -> None:
             c,
         )
     )
+    rows.append(
+        _fmt(
+            "stage (device-hash, numpy)",
+            _t(lambda: ed.prepare_batch_packed_dh(cm, ck, cs), args.reps),
+            c,
+        )
+    )
+    rows.append(f"{'  -> e2e rides':<28} {'device-hash' if device_hash else 'host-hash'} staging + kernel")
 
     padded = ed._pad(staged["packed"], verifier._bucket(c))
 
