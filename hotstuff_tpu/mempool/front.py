@@ -10,7 +10,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from ..network.net import Address, read_frame
+from ..network.net import Address, FrameReader
 from ..utils.actors import spawn
 
 log = logging.getLogger("hotstuff.mempool")
@@ -44,9 +44,10 @@ class Front:
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        frames = FrameReader(reader)
         while True:
             try:
-                tx = await read_frame(reader)
+                tx = await frames.next_frame()
             except ConnectionError:
                 break
             if tx is None:

@@ -46,19 +46,52 @@ def frame(data: bytes) -> bytes:
     return struct.pack(">I", len(data)) + data
 
 
-async def read_frame(reader: asyncio.StreamReader) -> bytes | None:
-    """Read one length-delimited frame; None on clean EOF."""
-    try:
-        header = await reader.readexactly(4)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
-    (length,) = struct.unpack(">I", header)
-    if length > MAX_FRAME:
-        raise ConnectionError(f"frame too large: {length}")
-    try:
-        return await reader.readexactly(length)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
+class FrameReader:
+    """Bulk-buffered frame reader: one stream read yields every complete
+    frame already in the TCP buffer, so the per-frame event-loop cost is
+    amortized. The previous per-frame readexactly pair costs two awaits
+    PER FRAME — at a 30k tx/s ingress the saturated-node profile showed
+    those awaits as ~15% of node CPU (data/profiles/). Returns None on
+    EOF (clean or mid-frame); raises ConnectionError on a frame whose
+    declared length exceeds the Byzantine MAX_FRAME cap."""
+
+    __slots__ = ("_reader", "_buf", "_off")
+
+    READ_SIZE = 256 * 1024
+
+    def __init__(self, reader: asyncio.StreamReader) -> None:
+        self._reader = reader
+        # bytearray: += grows in place (amortized O(chunk)); an immutable-
+        # bytes rebuild per refill would be O(buffer) per read — quadratic
+        # for any frame larger than READ_SIZE, and a CPU-DoS lever for a
+        # peer trickling a MAX_FRAME-sized declaration in small segments.
+        self._buf = bytearray()
+        self._off = 0
+
+    async def next_frame(self) -> bytes | None:
+        while True:
+            have = len(self._buf) - self._off
+            if have >= 4:
+                length = int.from_bytes(
+                    self._buf[self._off : self._off + 4], "big"
+                )
+                if length > MAX_FRAME:
+                    raise ConnectionError(f"frame too large: {length}")
+                if have >= 4 + length:
+                    start = self._off + 4
+                    data = bytes(self._buf[start : start + length])
+                    self._off = start + length
+                    return data
+            if self._off:  # compact consumed prefix before refilling
+                del self._buf[: self._off]
+                self._off = 0
+            try:
+                chunk = await self._reader.read(self.READ_SIZE)
+            except (ConnectionError, OSError):
+                return None
+            if not chunk:
+                return None
+            self._buf += chunk
 
 
 class NetSender:
@@ -145,9 +178,10 @@ class NetReceiver:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
+        frames = FrameReader(reader)
         while True:
             try:
-                data = await read_frame(reader)
+                data = await frames.next_frame()
             except ConnectionError as e:
                 log.warning("%s: dropping connection from %s: %s", self._name, peer, e)
                 break

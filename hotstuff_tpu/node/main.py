@@ -133,12 +133,22 @@ def main(argv: list[str] | None = None) -> None:
     args = parser.parse_args(argv)
     setup_logging(args.verbose)
 
+    # GIL switch interval: the saturated-node profile (data/profiles/)
+    # shows ~5 ms stalls on every to_thread crypto dispatch — the default
+    # sys.setswitchinterval(0.005) convoy between the event loop and the
+    # verification worker threads. A shorter interval cuts the handoff
+    # latency on single-core hosts.
+    import os
+    import sys as _sys
+
+    _sys.setswitchinterval(
+        float(os.environ.get("HOTSTUFF_SWITCH_INTERVAL", "0.001"))
+    )
+
     # HOTSTUFF_PROFILE=<path>: run the node under cProfile and dump stats
     # to <path>.<pid> on SIGTERM/exit (SURVEY §5.5 observability; used by
     # the protocol-plane ceiling analysis in data/profiles/).
     profile_path = None
-    import os
-
     if args.command == "run" and os.environ.get("HOTSTUFF_PROFILE"):
         import cProfile
 

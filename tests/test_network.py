@@ -74,3 +74,72 @@ def test_send_to_dead_peer_drops(run_async, base_port):
         assert await asyncio.wait_for(delivered.get(), 5.0) == b"arrives"
 
     run_async(body())
+
+
+def test_frame_reader_bulk_and_partial(run_async, base_port):
+    """FrameReader: many frames per TCP burst, frames split across reads,
+    and clean EOF -> None."""
+
+    async def body():
+        from hotstuff_tpu.network.net import FrameReader, frame
+
+        port = base_port + 50
+        got = []
+        done = asyncio.Event()
+
+        async def handle(reader, writer):
+            frames = FrameReader(reader)
+            while True:
+                data = await frames.next_frame()
+                if data is None:
+                    break
+                got.append(data)
+            done.set()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", port)
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        # burst: 50 frames in one write
+        w.write(b"".join(frame(bytes([i]) * (i + 1)) for i in range(50)))
+        await w.drain()
+        # split: a frame delivered byte-by-byte
+        payload = frame(b"splitsplit")
+        for i in range(len(payload)):
+            w.write(payload[i : i + 1])
+            await w.drain()
+        w.close()
+        await asyncio.wait_for(done.wait(), 5)
+        assert len(got) == 51
+        assert got[0] == b"\x00" and got[49] == bytes([49]) * 50
+        assert got[50] == b"splitsplit"
+        server.close()
+
+    run_async(body())
+
+
+def test_frame_reader_oversized_frame_raises(run_async, base_port):
+    async def body():
+        from hotstuff_tpu.network.net import FrameReader
+
+        port = base_port + 51
+        outcome = []
+
+        async def handle(reader, writer):
+            frames = FrameReader(reader)
+            try:
+                await frames.next_frame()
+                outcome.append("returned")
+            except ConnectionError:
+                outcome.append("raised")
+
+        server = await asyncio.start_server(handle, "127.0.0.1", port)
+        _, w = await asyncio.open_connection("127.0.0.1", port)
+        w.write(b"\xff\xff\xff\xff" + b"x" * 64)  # Byzantine length prefix
+        await w.drain()
+        for _ in range(100):
+            if outcome:
+                break
+            await asyncio.sleep(0.01)
+        assert outcome == ["raised"]
+        server.close()
+
+    run_async(body())

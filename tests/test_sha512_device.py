@@ -132,7 +132,7 @@ def test_verifier_auto_selects_device_hash():
     assert mask2.tolist() == [True, False, True, True]
 
 
-def test_sharded_device_hash_matches(run_async=None):
+def test_sharded_device_hash_matches():
     from hotstuff_tpu.parallel import ShardedEd25519Verifier, default_mesh
 
     from __graft_entry__ import _signed_batch
