@@ -68,20 +68,26 @@ class FrameReader:
         self._buf = bytearray()
         self._off = 0
 
+    def _try_parse(self) -> bytes | None:
+        """Pop one complete frame from the buffer, or None if incomplete."""
+        have = len(self._buf) - self._off
+        if have < 4:
+            return None
+        length = int.from_bytes(self._buf[self._off : self._off + 4], "big")
+        if length > MAX_FRAME:
+            raise ConnectionError(f"frame too large: {length}")
+        if have < 4 + length:
+            return None
+        start = self._off + 4
+        data = bytes(self._buf[start : start + length])
+        self._off = start + length
+        return data
+
     async def next_frame(self) -> bytes | None:
         while True:
-            have = len(self._buf) - self._off
-            if have >= 4:
-                length = int.from_bytes(
-                    self._buf[self._off : self._off + 4], "big"
-                )
-                if length > MAX_FRAME:
-                    raise ConnectionError(f"frame too large: {length}")
-                if have >= 4 + length:
-                    start = self._off + 4
-                    data = bytes(self._buf[start : start + length])
-                    self._off = start + length
-                    return data
+            data = self._try_parse()
+            if data is not None:
+                return data
             if self._off:  # compact consumed prefix before refilling
                 del self._buf[: self._off]
                 self._off = 0
@@ -92,6 +98,7 @@ class FrameReader:
             if not chunk:
                 return None
             self._buf += chunk
+
 
 
 class NetSender:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import logging
 import sys
 
 from ..utils.logging import setup_logging
@@ -139,11 +140,15 @@ def main(argv: list[str] | None = None) -> None:
     # verification worker threads. A shorter interval cuts the handoff
     # latency on single-core hosts.
     import os
-    import sys as _sys
 
-    _sys.setswitchinterval(
-        float(os.environ.get("HOTSTUFF_SWITCH_INTERVAL", "0.001"))
-    )
+    try:
+        sys.setswitchinterval(
+            float(os.environ.get("HOTSTUFF_SWITCH_INTERVAL", "0.001"))
+        )
+    except ValueError:
+        logging.getLogger("hotstuff.node").warning(
+            "ignoring malformed HOTSTUFF_SWITCH_INTERVAL"
+        )
 
     # HOTSTUFF_PROFILE=<path>: run the node under cProfile and dump stats
     # to <path>.<pid> on SIGTERM/exit (SURVEY §5.5 observability; used by

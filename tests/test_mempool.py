@@ -172,30 +172,73 @@ def test_payload_request_served(run_async, base_port):
     run_async(body())
 
 
-def test_front_drop_oldest_admission_control(run_async, base_port):
+class _ScriptReader:
+    """Scripted stream: each chunk is one read() result; EOF after."""
+
+    def __init__(self, chunks):
+        self.chunks = list(chunks)
+
+    async def read(self, n):
+        return self.chunks.pop(0) if self.chunks else b""
+
+
+class _FakeWriter:
+    def close(self):
+        pass
+
+
+def _bare_front(q):
+    from hotstuff_tpu.mempool.front import Front
+
+    front = Front.__new__(Front)  # no listener: drive _handle directly
+    front._deliver = q
+    front.dropped = 0
+    return front
+
+
+def test_front_drop_oldest_admission_control(run_async):
     """Overload: a full intake queue evicts the OLDEST tx for the newest
     (bounded, fresh) instead of blocking the reader (unbounded latency)."""
 
     async def body():
-        from hotstuff_tpu.mempool.front import Front
-
         q = channel(3)
-        port = base_port + 70
-        front = Front(("127.0.0.1", port), q)
-        await asyncio.sleep(0.05)  # listener up
-        _, w = await asyncio.open_connection("127.0.0.1", port)
-        for i in range(10):
-            w.write(frame(bytes([i]) * 12))
-        await w.drain()
-        for _ in range(100):
-            if front.dropped >= 7:
-                break
-            await asyncio.sleep(0.01)
+        front = _bare_front(q)
+        reader = _ScriptReader([frame(bytes([i]) * 12) for i in range(10)])
+        await front._handle(reader, _FakeWriter())
         assert front.dropped == 7
         assert q.qsize() == 3
         kept = [q.get_nowait()[0] for _ in range(3)]
         assert kept == [7, 8, 9], "queue must hold the newest transactions"
-        w.close()
+
+    run_async(body())
+
+
+def test_front_parses_whole_burst(run_async):
+    """A multi-frame TCP burst is fully drained from one read."""
+
+    async def body():
+        q = channel(10)
+        front = _bare_front(q)
+        burst = b"".join(frame(bytes([i]) * 8) for i in range(5))
+        await front._handle(_ScriptReader([burst]), _FakeWriter())
+        assert q.qsize() == 5
+        assert [q.get_nowait()[0] for _ in range(5)] == [0, 1, 2, 3, 4]
+        assert front.dropped == 0
+
+    run_async(body())
+
+
+def test_front_survives_byzantine_length_in_burst(run_async):
+    """An oversized length prefix buffered BEHIND a valid frame must drop
+    the connection cleanly (valid prefix delivered, no exception escapes
+    the handler)."""
+
+    async def body():
+        q = channel(10)
+        front = _bare_front(q)
+        burst = frame(b"ok-tx-1") + b"\xff\xff\xff\xff" + b"x" * 32
+        await front._handle(_ScriptReader([burst]), _FakeWriter())
+        assert q.qsize() == 1 and q.get_nowait() == b"ok-tx-1"
 
     run_async(body())
 

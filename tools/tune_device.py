@@ -1,0 +1,206 @@
+"""One-shot device tuning session: run every TPU microbenchmark that the
+round-4 perf work needs, in one process (the chip is process-exclusive and
+has been intermittently reachable — batch everything).
+
+Sections (each skippable):
+  --vpu        int32 vs f32 elementwise multiply rate (decides whether a
+               radix-2^13 int32 limb field is worth building)
+  --phases     wall-time decomposition of the pallas verify: decompress +
+               table build vs ladder vs compress (where the non-ladder 14%
+               of ops actually lands in wall-clock)
+  --block      pallas ladder rate at the current BLOCK (recompile sweep is
+               manual: edit pallas_ladder.BLOCK)
+  --chunks     e2e rate vs pipeline chunk size (2048/4096/8192)
+  --dh         device-hash vs host-hash packed e2e comparison
+
+Usage: python tools/tune_device.py [--all] [--vpu] [--phases] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+
+def _sync(x):
+    return np.asarray(x)
+
+
+def bench_vpu(reps: int = 20) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    shape = (64, 4096)
+
+    def chain_f32(x):
+        for _ in range(64):
+            x = x * x + 1.0
+        return x
+
+    def chain_i32(x):
+        for _ in range(64):
+            x = x * x + 1
+        return x
+
+    def chain_u32_logic(x):
+        for _ in range(64):
+            x = (x ^ (x >> 7)) + (x << 3)
+        return x
+
+    for name, fn, arr in (
+        ("f32 mul+add", chain_f32, jnp.ones(shape, jnp.float32) * 1.0001),
+        ("i32 mul+add", chain_i32, jnp.ones(shape, jnp.int32) * 3),
+        ("u32 xor/shift/add", chain_u32_logic, jnp.ones(shape, jnp.uint32) * 3),
+    ):
+        jit = jax.jit(fn)
+        _sync(jit(arr))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = jit(arr)
+        _sync(out)
+        dt = time.perf_counter() - t0
+        ops = 64 * 2 * shape[0] * shape[1] * reps
+        print(f"vpu {name:<20} {ops / dt / 1e12:8.3f} T op/s")
+
+
+def bench_phases(batch: int = 4096, reps: int = 5) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _signed_batch
+    from hotstuff_tpu.ops import ed25519 as ed
+    from hotstuff_tpu.ops import pallas_ladder as pl_mod
+    from hotstuff_tpu.ops import sha512 as sha
+
+    msgs, pks, sigs = _signed_batch(batch)
+    staged = ed.prepare_batch(msgs, pks, sigs)
+    a_y = jax.device_put(staged["a_y"])
+    a_sign = jax.device_put(staged["a_sign"])
+    r_enc = jax.device_put(staged["r_enc"])
+    s_d = jax.device_put(staged["s_digits"])
+    h_d = jax.device_put(staged["h_digits"])
+
+    decomp = jax.jit(lambda y, s: ed.decompress(y, s))
+    table = jax.jit(
+        lambda y, s: ed._build_neg_a_table(ed.decompress(y, s)[1], y)
+    )
+    full = pl_mod._verify_pallas_jit
+
+    ta = table(a_y, a_sign)
+    ladder = jax.jit(
+        lambda sd, hd, t0, t1, t2, t3: pl_mod.ladder_pallas(
+            sd, hd, t0, t1, t2, t3
+        )
+    )
+    lad_out = ladder(s_d, h_d, *ta)
+    comp = jax.jit(lambda p: ed.compress(p))
+
+    dhm = jax.device_put(
+        np.frombuffer(b"".join(msgs), np.uint8).reshape(batch, 32).T.copy()
+    )
+    dha = jax.device_put(
+        np.frombuffer(b"".join(pks), np.uint8).reshape(batch, 32).T.copy()
+    )
+    dhr = jax.device_put(
+        np.frombuffer(b"".join(s[:32] for s in sigs), np.uint8)
+        .reshape(batch, 32)
+        .T.copy()
+    )
+    hashfn = jax.jit(sha.h_digits_on_device)
+
+    rows = [
+        ("decompress", lambda: decomp(a_y, a_sign)),
+        ("decompress+table", lambda: table(a_y, a_sign)),
+        ("ladder (pallas)", lambda: ladder(s_d, h_d, *ta)),
+        ("compress", lambda: comp(lad_out)),
+        ("sha512+modL (dh)", lambda: hashfn(dhr, dha, dhm)),
+        ("full verify", lambda: full(a_y, a_sign, r_enc, s_d, h_d)),
+    ]
+    for name, fn in rows:
+        _sync(jax.tree_util.tree_leaves(fn())[0])  # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        _sync(jax.tree_util.tree_leaves(out)[0])
+        dt = (time.perf_counter() - t0) / reps
+        print(f"phase {name:<18} {dt * 1e3:8.2f} ms  {batch / dt:>10,.0f}/s")
+
+
+def bench_chunks(batch: int = 16384, iters: int = 3, kernel: str = "pallas") -> None:
+    from __graft_entry__ import _signed_batch
+    from hotstuff_tpu.ops import ed25519 as ed
+
+    msgs, pks, sigs = _signed_batch(batch)
+    for chunk in (2048, 4096, 8192):
+        v = ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=chunk)
+        assert v.verify_batch_mask(msgs, pks, sigs).all()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            v.verify_batch_mask(msgs, pks, sigs)
+        rate = batch * iters / (time.perf_counter() - t0)
+        print(f"chunk {chunk:>5}  e2e {rate:>10,.0f} sigs/s")
+
+
+def bench_dh(batch: int = 8192, iters: int = 4, kernel: str = "pallas") -> None:
+    """Device-hash vs host-hash e2e on the same batch."""
+    from __graft_entry__ import _signed_batch
+    from hotstuff_tpu.ops import ed25519 as ed
+
+    msgs, pks, sigs = _signed_batch(batch)
+    v = ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=4096)
+
+    # Time both wire formats directly (staging + upload + kernel), bypassing
+    # verify_batch_mask's auto-selection so each path is measured alone.
+    for name, stage, fn in (
+        ("host-hash", ed.prepare_batch_packed, v._packed_fn()),
+        ("device-hash", ed.prepare_batch_packed_dh, v._packed_dh_fn()),
+    ):
+        import jax
+
+        staged = stage(msgs[:4096], pks[:4096], sigs[:4096])
+        padded = ed._pad(staged["packed"], 4096)
+        mask = np.asarray(fn(jax.device_put(padded)))
+        assert mask.all()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            s = stage(msgs[:4096], pks[:4096], sigs[:4096])
+            out = fn(jax.device_put(ed._pad(s["packed"], 4096)))
+        np.asarray(out)
+        rate = 4096 * iters / (time.perf_counter() - t0)
+        print(f"dh-compare {name:<12} {rate:>10,.0f} sigs/s (serial, no pipeline)")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    for flag in ("all", "vpu", "phases", "chunks", "dh", "cpu"):
+        ap.add_argument(f"--{flag}", action="store_true")
+    args = ap.parse_args()
+    from hotstuff_tpu.ops import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    if args.cpu:
+        # The axon hook force-sets JAX_PLATFORMS=axon at import; smoke runs
+        # must override AFTER import (same dance as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    print(f"# devices: {jax.devices()}")
+    if args.all or args.vpu:
+        bench_vpu()
+    if args.all or args.phases:
+        bench_phases()
+    kernel = "w4" if args.cpu else "pallas"
+    if args.all or args.chunks:
+        bench_chunks(kernel=kernel)
+    if args.all or args.dh:
+        bench_dh(kernel=kernel)
+
+
+if __name__ == "__main__":
+    main()
