@@ -32,6 +32,7 @@ and doubling inputs, so the ladder needs no special cases.
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
@@ -42,6 +43,8 @@ import numpy as np
 from jax import lax
 
 from . import field as f
+
+log = logging.getLogger("hotstuff.ops")
 
 P = f.P
 L_ORDER = 2**252 + 27742317777372353535851937790883648493
@@ -81,8 +84,13 @@ def point_identity(batch: int, dtype=jnp.float32) -> Point:
     return zero, one, one, zero
 
 
-def point_dbl(p: Point) -> Point:
-    """dbl-2008-hwcd for a=-1 (complete for doubling, identity included)."""
+def point_dbl(p: Point, with_t: bool = True) -> Point:
+    """dbl-2008-hwcd for a=-1 (complete for doubling, identity included).
+
+    Doubling never READS the input T, so a doubling whose consumer is
+    another doubling can skip producing it (`with_t=False`, one field mul
+    saved — 3 of every 4 ladder doublings qualify, ~5% of kernel ops);
+    the returned T is zeros then, and must not feed an addition."""
     X, Y, Z, _ = p
     xx = f.sqr(X)
     yy = f.sqr(Y)
@@ -93,7 +101,8 @@ def point_dbl(p: Point) -> Point:
     zp = f.sub(yy, xx)
     xp = f.sub(aa, yp)  # = 2XY
     tp = f.sub(zz2, zp)
-    return f.mul(xp, tp), f.mul(yp, zp), f.mul(zp, tp), f.mul(xp, yp)
+    t_out = f.mul(xp, yp) if with_t else jnp.zeros_like(xp)
+    return f.mul(xp, tp), f.mul(yp, zp), f.mul(zp, tp), t_out
 
 
 def point_madd(p: Point, q_ypx, q_ymx, q_xy2d) -> Point:
@@ -114,9 +123,11 @@ def _select_point(mask: jnp.ndarray, a: Point, b: Point) -> Point:
     return tuple(f.select(mask, x, y) for x, y in zip(a, b))
 
 
-def point_add_cached(p: Point, q_ypx, q_ymx, q_z, q_t2d) -> Point:
+def point_add_cached(p: Point, q_ypx, q_ymx, q_z, q_t2d, with_t: bool = True) -> Point:
     """Unified addition with a cached point (Y2+X2, Y2-X2, Z2, 2d*T2)
-    (add-2008-hwcd-3). Cached identity is (1, 1, 1, 0)."""
+    (add-2008-hwcd-3). Cached identity is (1, 1, 1, 0). `with_t=False`
+    skips producing T (valid when the consumer is a doubling or the final
+    compress, neither of which reads it)."""
     X1, Y1, Z1, T1 = p
     a = f.mul(f.add(Y1, X1), q_ypx)
     b = f.mul(f.sub(Y1, X1), q_ymx)
@@ -127,7 +138,8 @@ def point_add_cached(p: Point, q_ypx, q_ymx, q_z, q_t2d) -> Point:
     y3 = f.add(a, b)
     z3 = f.add(d2z, c)
     t3 = f.sub(d2z, c)
-    return f.mul(x3, t3), f.mul(y3, z3), f.mul(z3, t3), f.mul(x3, y3)
+    t_out = f.mul(x3, y3) if with_t else jnp.zeros_like(x3)
+    return f.mul(x3, t3), f.mul(y3, z3), f.mul(z3, t3), t_out
 
 
 # --- 4-bit windowed ladder -------------------------------------------------
@@ -227,8 +239,11 @@ def _verify_kernel_w4(a_y, a_sign, r_enc, s_digits, h_digits):
 
     def body(g, acc: Point) -> Point:
         row = NGROUPS - 1 - g
-        for _ in range(WINDOW):
-            acc = point_dbl(acc)
+        # Only the LAST doubling needs T (the madd reads it); the group-
+        # final cached add skips T too (its consumer is the next group's
+        # doubling, or compress — neither reads T).
+        for i in range(WINDOW):
+            acc = point_dbl(acc, with_t=i == WINDOW - 1)
         sd = lax.dynamic_index_in_dim(s_digits, row, 0, keepdims=False)
         hd = lax.dynamic_index_in_dim(h_digits, row, 0, keepdims=False)
         s_oh = jax.nn.one_hot(sd.astype(jnp.int32), 16, axis=0, dtype=a_y.dtype)
@@ -245,6 +260,7 @@ def _verify_kernel_w4(a_y, a_sign, r_enc, s_digits, h_digits):
             _lookup_per_item(ta_ymx, h_oh),
             _lookup_per_item(ta_z, h_oh),
             _lookup_per_item(ta_t2d, h_oh),
+            with_t=False,
         )
         return acc
 
@@ -610,6 +626,11 @@ class Ed25519TpuVerifier:
         self.packed = packed if packed is not None else kernel != "bits"
         self.chunk = min(chunk or 4096, max_bucket)
         self._put = None  # optional device_put override (mesh sharding)
+        # Device-hash health latch: if the SHA-512/mod-L kernel ever fails
+        # at runtime (an unexpected backend lowering gap would otherwise
+        # take down every verification), fall back to host hashing for the
+        # life of this verifier.
+        self._device_hash_ok = True
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -651,7 +672,29 @@ class Ed25519TpuVerifier:
         # Device-hash fast path: when every message is a 32-byte digest
         # (the protocol hot path), h is computed on device and host
         # staging is pure byte concatenation.
-        device_hash = all(len(m) == 32 for m in messages)
+        device_hash = self._device_hash_ok and all(
+            len(m) == 32 for m in messages
+        )
+        try:
+            return self._run_packed(messages, keys, signatures, device_hash)
+        except Exception:
+            if not device_hash:
+                raise
+            # An unexpected backend failure in the SHA-512/mod-L kernel
+            # must not take down verification: redo the batch with
+            # host-side hashing. Latch the fast path off ONLY if the host
+            # path succeeds where device-hash failed (a deterministic
+            # kernel problem) — a transient device outage makes the retry
+            # raise too, and the latch stays untouched for recovery.
+            log.exception(
+                "device-hash kernel failed; retrying with host hashing"
+            )
+            out = self._run_packed(messages, keys, signatures, False)
+            self._device_hash_ok = False
+            return out
+
+    def _run_packed(self, messages, keys, signatures, device_hash: bool):
+        n = len(messages)
         fn = self._packed_dh_fn() if device_hash else self._packed_fn()
         stage = prepare_batch_packed_dh if device_hash else prepare_batch_packed
         up = _uploader()

@@ -86,8 +86,10 @@ def _ladder_kernel(
     )
 
     def group(g, acc):
-        for _ in range(ed.WINDOW):
-            acc = ed.point_dbl(acc)
+        # T-skip schedule: see ed._verify_kernel_w4.body — only the last
+        # doubling (feeding the madd) produces T; the cached add skips it.
+        for i in range(ed.WINDOW):
+            acc = ed.point_dbl(acc, with_t=i == ed.WINDOW - 1)
         row = ed.NGROUPS - 1 - g
         sdg = _digit_row(sd, row)
         hdg = _digit_row(hd, row)
@@ -103,6 +105,7 @@ def _ladder_kernel(
             _lookup_item(ta_ymx, hdg),
             _lookup_item(ta_z, hdg),
             _lookup_item(ta_t2d, hdg),
+            with_t=False,
         )
         return acc
 

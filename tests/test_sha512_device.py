@@ -144,3 +144,44 @@ def test_sharded_device_hash_matches():
     want = [True] * 16
     want[9] = False
     assert mask.tolist() == want
+
+
+def test_device_hash_failure_falls_back_to_host(monkeypatch):
+    """A runtime failure in the device-hash kernel must latch off and the
+    batch redo with host hashing — verification never goes down with it."""
+    from __graft_entry__ import _signed_batch
+
+    v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
+    msgs, pks, sigs = _signed_batch(5, seed=21)
+    sigs[2] = bytes(64)
+
+    def boom():
+        def fail(*a, **k):
+            raise RuntimeError("injected lowering failure")
+
+        return fail
+
+    monkeypatch.setattr(v, "_packed_dh_fn", boom)
+    mask = v.verify_batch_mask(msgs, pks, sigs)
+    assert mask.tolist() == [True, True, False, True, True]
+    assert v._device_hash_ok is False
+    # subsequent batches go straight to host hashing
+    mask2 = v.verify_batch_mask(msgs, pks, sigs)
+    assert mask2.tolist() == [True, True, False, True, True]
+
+
+def test_transient_device_failure_does_not_latch(monkeypatch):
+    """If the host-hash retry fails TOO (device down, not a kernel bug),
+    the error propagates and the device-hash latch stays on for recovery."""
+    from __graft_entry__ import _signed_batch
+
+    v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
+    msgs, pks, sigs = _signed_batch(3, seed=22)
+
+    def fail(*a, **k):
+        raise RuntimeError("device unreachable")
+
+    monkeypatch.setattr(v, "_run_packed", fail)
+    with pytest.raises(RuntimeError):
+        v.verify_batch_mask(msgs, pks, sigs)
+    assert v._device_hash_ok is True  # transient: fast path not latched off

@@ -40,8 +40,12 @@ SEQ_CARRY = 32 * 6  # fori: index, add, floor-mul, sub, update, carry
 CANONICAL = 3 * SEQ_CARRY + 2 * (32 + SEQ_CARRY + 32)  # 3 passes + 2 cond-sub
 
 DBL = 4 * SQR + 4 * MUL + 1 * ADD + 3 * SUB + 2 * ADD
+# T-skip schedule (round 4): a doubling feeding another doubling skips the
+# T-coordinate mul (3 of 4 per group), as does the group-final cached add.
+DBL_NO_T = DBL - MUL
 MADD = 7 * MUL + 2 * ADD + 2 * SUB + 2 * ADD
 CADD = 8 * MUL + 2 * ADD + 2 * SUB + 2 * ADD
+CADD_NO_T = CADD - MUL
 
 # pow chains (ref10): ~254 squarings + ~12 muls each
 POW_CHAIN = 254 * SQR + 12 * MUL
@@ -55,7 +59,13 @@ LOOKUP_ITEM = 4 * 16 * 32 * 2
 DIGIT_ROW = 2 * 64 * 3
 
 LADDER = NGROUPS * (
-    WINDOW * DBL + MADD + CADD + LOOKUP_SHARED + LOOKUP_ITEM + DIGIT_ROW
+    (WINDOW - 1) * DBL_NO_T
+    + DBL
+    + MADD
+    + CADD_NO_T
+    + LOOKUP_SHARED
+    + LOOKUP_ITEM
+    + DIGIT_ROW
 )
 TABLE_BUILD = 14 * MADD + 3 * MUL + 4 * ADD  # _build_neg_a_table
 DECOMPRESS = (

@@ -8,8 +8,6 @@ Sections (each skippable):
   --phases     wall-time decomposition of the pallas verify: decompress +
                table build vs ladder vs compress (where the non-ladder 14%
                of ops actually lands in wall-clock)
-  --block      pallas ladder rate at the current BLOCK (recompile sweep is
-               manual: edit pallas_ladder.BLOCK)
   --chunks     e2e rate vs pipeline chunk size (2048/4096/8192)
   --dh         device-hash vs host-hash packed e2e comparison
 
