@@ -1,0 +1,96 @@
+"""Value-level property tests for the experimental radix-2^12 uint32 field
+(ops/field12.py) against Python bigints — same strategy as
+test_field_fuzz.py for the production f32 field."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from hotstuff_tpu.ops import field12 as f12
+
+P = f12.P
+RNG = random.Random(41)
+
+
+def _batch_of(vals):
+    cols = [f12.limbs_of_int(v) for v in vals]
+    return np.concatenate(cols, axis=1)
+
+
+def _vals(n, lo=0, hi=P):
+    out = [RNG.randrange(lo, hi) for _ in range(n - 4)]
+    return [0, 1, P - 1, (1 << 255) - 20] + out
+
+
+def test_roundtrip():
+    vals = _vals(32)
+    assert f12.int_of_limbs(_batch_of(vals)) == vals
+
+
+def test_mul_exact():
+    a_v, b_v = _vals(64), _vals(64)
+    got = f12.int_of_limbs(
+        jax.jit(f12.mul)(_batch_of(a_v), _batch_of(b_v))
+    )
+    for g, a, b in zip(got, a_v, b_v):
+        assert g % P == (a * b) % P
+
+
+def test_sqr_matches_mul():
+    vals = _vals(64)
+    arr = _batch_of(vals)
+    got = f12.int_of_limbs(jax.jit(f12.sqr)(arr))
+    for g, v in zip(got, vals):
+        assert g % P == (v * v) % P
+
+
+def test_add_sub_roundtrip():
+    a_v, b_v = _vals(48), _vals(48)
+    a, b = _batch_of(a_v), _batch_of(b_v)
+    s = jax.jit(f12.sub)(f12.add(a, b), b)
+    for g, v in zip(f12.int_of_limbs(s), a_v):
+        assert g % P == v % P
+
+
+def test_mul_chain_stays_exact():
+    """Repeated mul/sqr/add/sub with lazily-reduced intermediates: any
+    uint32 overflow or carry-bound violation shows up as a wrong value."""
+    vals = _vals(32)
+    arr = _batch_of(vals)
+    want = list(vals)
+
+    def step(x):
+        y = f12.sqr(x)
+        z = f12.mul(x, y)
+        w = f12.sub(f12.add(z, y), x)
+        return f12.mul(w, w)
+
+    fn = jax.jit(step)
+    for _ in range(8):
+        arr = fn(arr)
+        want = [((v * v * v + v * v - v) ** 2) % P for v in want]
+    got = f12.int_of_limbs(arr)
+    for g, v in zip(got, want):
+        assert g % P == v
+
+
+def test_canonical():
+    vals = _vals(48) + [P, P + 1, 2 * P - 1]
+    arr = _batch_of([v % (1 << 264) for v in vals])
+    out = np.asarray(jax.jit(f12.canonical)(arr))
+    assert out.max() <= f12.MASK
+    got = f12.int_of_limbs(out)
+    for g, v in zip(got, vals):
+        assert g == v % P, hex(v)
+
+
+def test_normalized_bounds():
+    """carry() must respect its documented per-limb bounds (mul input
+    exactness depends on them)."""
+    vals = _vals(64)
+    out = np.asarray(jax.jit(f12.mul)(_batch_of(vals), _batch_of(vals[::-1])))
+    assert out[0].max() <= f12.RADIX + f12.FOLD + 64
+    assert out[1:].max() <= f12.RADIX + 64

@@ -8,6 +8,7 @@ Sections (each skippable):
   --phases     wall-time decomposition of the pallas verify: decompress +
                table build vs ladder vs compress (where the non-ladder 14%
                of ops actually lands in wall-clock)
+  --field      f32 radix-256 vs u32 radix-2^12 field sqr-chain rate
   --chunks     e2e rate vs pipeline chunk size (2048/4096/8192)
   --dh         device-hash vs host-hash packed e2e comparison
 
@@ -65,6 +66,45 @@ def bench_vpu(reps: int = 20) -> None:
         dt = time.perf_counter() - t0
         ops = 64 * 2 * shape[0] * shape[1] * reps
         print(f"vpu {name:<20} {ops / dt / 1e12:8.3f} T op/s")
+
+
+def bench_field(batch: int = 4096, chain: int = 64, reps: int = 10) -> None:
+    """f32 radix-256 field vs experimental uint32 radix-2^12 field: a
+    chain of `chain` squarings, batched — the kernel-shaped workload.
+    Decides whether the 2.1x-fewer-products int field is worth porting
+    the verify kernel to (depends on the VPU's int32 multiply rate)."""
+    import jax
+
+    from hotstuff_tpu.ops import field as f32f
+    from hotstuff_tpu.ops import field12 as f12
+
+    import random
+
+    rng = random.Random(5)
+    vals = [rng.randrange(f32f.P) for _ in range(batch)]
+
+    from jax import lax
+
+    for name, mod in (("f32 radix-256", f32f), ("u32 radix-2^12", f12)):
+        arr = jax.device_put(
+            np.concatenate([mod.limbs_of_int(v) for v in vals[:batch]], axis=1)
+        )
+        # Chain the REAL sqr (symmetric convolution) — sqr_n uses mul(x,x)
+        # in both fields, which would measure the wrong op for the
+        # sqr-heavy kernel (pow chains, doublings).
+        fn = jax.jit(
+            lambda x, m=mod: lax.fori_loop(
+                0, chain, lambda _, y: m.sqr(y), x
+            )
+        )
+        _sync(fn(arr))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(arr)
+        _sync(out)
+        dt = time.perf_counter() - t0
+        rate = batch * chain * reps / dt
+        print(f"field {name:<16} {rate / 1e6:8.2f} M field-sqr/s")
 
 
 def bench_phases(batch: int = 4096, reps: int = 5) -> None:
@@ -176,7 +216,7 @@ def bench_dh(batch: int = 8192, iters: int = 4, kernel: str = "pallas") -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    for flag in ("all", "vpu", "phases", "chunks", "dh", "cpu"):
+    for flag in ("all", "vpu", "field", "phases", "chunks", "dh", "cpu"):
         ap.add_argument(f"--{flag}", action="store_true")
     args = ap.parse_args()
     from hotstuff_tpu.ops import enable_persistent_cache
@@ -191,6 +231,8 @@ def main() -> None:
     print(f"# devices: {jax.devices()}")
     if args.all or args.vpu:
         bench_vpu()
+    if args.all or args.field:
+        bench_field()
     if args.all or args.phases:
         bench_phases()
     kernel = "w4" if args.cpu else "pallas"
