@@ -169,15 +169,23 @@ def bench_committee_scale(
     committee size is a first-class scaling dimension; BASELINE configs go
     to 100 nodes). Prints a table; no JSON (the driver metric is main())."""
     print("committee  quorum   QCs  votes    cpu_sigs/s  tpu_e2e_sigs/s  speedup")
+    rows = []
     for committee in (4, 10, 16, 64, 100):
         msgs, pks, sigs, q, n_qc = _qc_batch(committee, total)
         n = len(msgs)
         tpu_rate = bench_e2e(msgs, pks, sigs, kernel, chunk, iters)
         cpu_rate = bench_cpu(msgs, pks, sigs, cpu_budget)
+        rows.append((committee, tpu_rate / cpu_rate))
         print(
             f"{committee:>9}  {q:>6}  {n_qc:>4}  {n:>5}  "
             f"{cpu_rate:>10,.0f}  {tpu_rate:>14,.0f}  {tpu_rate / cpu_rate:>6.1f}x"
         )
+    by_c = dict(rows)
+    target = by_c.get(64, 0.0)
+    print(
+        f"# north-star check: committee-64 e2e {target:.1f}x "
+        f"(target >= 10x) -> {'MET' if target >= 10 else 'NOT MET'}"
+    )
 
 
 def main() -> None:

@@ -156,7 +156,7 @@ def sqr(a):
 
 
 def sqr_n(a, n: int):
-    return lax.fori_loop(0, n, lambda _, x: mul(x, x), a)
+    return lax.fori_loop(0, n, lambda _, x: sqr(x), a)
 
 
 def select(mask, a, b):
@@ -182,12 +182,23 @@ def _cond_sub_p(x):
 
 
 def canonical(x):
-    """Normalized element -> THE canonical representative in [0, p)."""
+    """Normalized element -> THE canonical representative in [0, p).
+
+    Unlike the radix-256 field (value < 2^256 < 3p, two conditional
+    subtractions suffice), a 22x12-bit element spans 264 bits — up to
+    ~512p — so the bits above 2^255 must fold down first: 2^255 ≡ 19,
+    and bit 255 sits at bit 3 of limb 21. Two fold+carry passes bring
+    the value below p + 38, then two conditional subtractions finish."""
     x, cout = _seq_carry(x)
     x = x.at[0].add(cout * jnp.uint32(FOLD))
     x, cout = _seq_carry(x)
     x = x.at[0].add(cout * jnp.uint32(FOLD))
-    x, _ = _seq_carry(x)
+    x, _ = _seq_carry(x)  # limbs < 4096, value < 2^264
+    for _ in range(2):
+        q = x[NLIMB - 1] >> 3  # value >> 255, <= 2^9 after the seq carry
+        x = x.at[NLIMB - 1].set(x[NLIMB - 1] & jnp.uint32(7))
+        x = x.at[0].add(q * jnp.uint32(19))
+        x, _ = _seq_carry(x)
     x = _cond_sub_p(x)
     x = _cond_sub_p(x)
     return x

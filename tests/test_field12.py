@@ -78,13 +78,43 @@ def test_mul_chain_stays_exact():
 
 
 def test_canonical():
-    vals = _vals(48) + [P, P + 1, 2 * P - 1]
+    # raw encodings across the FULL 264-bit domain (values up to ~512p),
+    # plus boundary cases
+    vals = _vals(48) + [
+        P,
+        P + 1,
+        2 * P - 1,
+        2 * P,
+        (1 << 264) - 1,
+        500 * P + 7,
+    ]
+    vals += [RNG.randrange(1 << 264) for _ in range(64)]
     arr = _batch_of([v % (1 << 264) for v in vals])
     out = np.asarray(jax.jit(f12.canonical)(arr))
     assert out.max() <= f12.MASK
     got = f12.int_of_limbs(out)
     for g, v in zip(got, vals):
-        assert g == v % P, hex(v)
+        assert g == (v % (1 << 264)) % P, hex(v)
+
+
+def test_canonical_of_real_mul_outputs():
+    """Actual normalized mul outputs routinely exceed 2p (the review-found
+    bug class): canonical(mul(a, b)) must equal (a*b) % P exactly."""
+    a_v, b_v = _vals(64), _vals(64)
+    out = jax.jit(lambda a, b: f12.canonical(f12.mul(a, b)))(
+        _batch_of(a_v), _batch_of(b_v)
+    )
+    got = f12.int_of_limbs(out)
+    for g, a, b in zip(got, a_v, b_v):
+        assert g == (a * b) % P
+    # and equality of canonical forms across different computation routes
+    lhs = jax.jit(lambda a, b: f12.canonical(f12.mul(a, b)))(
+        _batch_of(a_v), _batch_of(b_v)
+    )
+    rhs = jax.jit(lambda a, b: f12.canonical(f12.mul(b, a)))(
+        _batch_of(a_v), _batch_of(b_v)
+    )
+    assert bool(np.asarray(f12.eq_canonical(lhs, rhs)).all())
 
 
 def test_normalized_bounds():

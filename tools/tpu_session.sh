@@ -1,0 +1,38 @@
+#!/bin/bash
+# One-shot TPU measurement session for the round-4 perf work.
+# Run when the axon relay (127.0.0.1:8082) is reachable; captures every
+# microbenchmark + the driver benchmarks into data/device/.
+#
+#   bash tools/tpu_session.sh
+#
+# Keep the host otherwise IDLE (1 vCPU: concurrent work corrupts timings).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p data/device
+stamp=$(date +%H%M%S)
+out="data/device/session_$stamp"
+mkdir -p "$out"
+
+if ! timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
+  echo "relay unreachable; aborting" >&2
+  exit 1
+fi
+
+run() {
+  name=$1; shift
+  echo "=== $name: $*"
+  timeout 1200 "$@" > "$out/$name.txt" 2>&1
+  echo "--- rc=$? tail:"
+  tail -5 "$out/$name.txt"
+}
+
+run tune_vpu    python tools/tune_device.py --vpu
+run tune_field  python tools/tune_device.py --field
+run tune_phases python tools/tune_device.py --phases
+run tune_chunks python tools/tune_device.py --chunks
+run tune_dh     python tools/tune_device.py --dh
+run profile_e2e python tools/profile_e2e.py
+run bench       python bench.py
+run bench_mesh  python bench.py --mesh
+run committee   python bench.py --committee-scale
+echo "session captured in $out"

@@ -175,14 +175,22 @@ def bench_chunks(batch: int = 16384, iters: int = 3, kernel: str = "pallas") -> 
     from hotstuff_tpu.ops import ed25519 as ed
 
     msgs, pks, sigs = _signed_batch(batch)
-    for chunk in (2048, 4096, 8192):
-        v = ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=chunk)
+    # chunk == batch means ONE upload + ONE dispatch: if per-RPC latency
+    # on the tunneled link dominates, fewer bigger transfers win even
+    # though pipelining overlap shrinks.
+    for chunk, bucket in (
+        (2048, 8192),
+        (4096, 8192),
+        (8192, 8192),
+        (16384, 16384),
+    ):
+        v = ed.Ed25519TpuVerifier(max_bucket=bucket, kernel=kernel, chunk=chunk)
         assert v.verify_batch_mask(msgs, pks, sigs).all()
         t0 = time.perf_counter()
         for _ in range(iters):
             v.verify_batch_mask(msgs, pks, sigs)
         rate = batch * iters / (time.perf_counter() - t0)
-        print(f"chunk {chunk:>5}  e2e {rate:>10,.0f} sigs/s")
+        print(f"chunk {chunk:>5} (bucket {bucket:>5})  e2e {rate:>10,.0f} sigs/s")
 
 
 def bench_dh(batch: int = 8192, iters: int = 4, kernel: str = "pallas") -> None:
