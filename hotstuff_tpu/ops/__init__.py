@@ -14,7 +14,51 @@ __all__ = [
     "prepare_batch",
     "prepare_batch_packed",
     "enable_persistent_cache",
+    "check_axon_relay",
 ]
+
+
+def check_axon_relay(port: int = 8082, timeout: float = 5.0) -> None:
+    """Fail fast (RuntimeError) when the axon TPU relay is unreachable —
+    jax device init otherwise blocks indefinitely with no diagnostics
+    (observed: the loopback relay process died mid-round and every device
+    probe hung for hours).
+
+    Fires when PALLAS_AXON_POOL_IPS is set, unless JAX_PLATFORMS already
+    selects a different backend explicitly (the axon import hook force-
+    sets JAX_PLATFORMS=axon during `import jax`, so an unset variable
+    still means the axon path will be taken). Every pool IP is probed;
+    any live relay passes."""
+    pool = os.environ.get("PALLAS_AXON_POOL_IPS")
+    plat = os.environ.get("JAX_PLATFORMS", "")
+    platforms = [p.strip() for p in plat.split(",") if p.strip()]
+    if not pool or (platforms and "axon" not in platforms):
+        return
+    # A caller that already imported jax and overrode the platform config
+    # (the tests/conftest.py CPU-mesh dance) is not going to touch axon.
+    import sys
+
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            cfg = jax_mod.config.jax_platforms
+            if cfg and "axon" not in str(cfg):
+                return
+        except Exception:
+            pass
+    import socket
+
+    errors = []
+    for ip in pool.split(","):
+        try:
+            socket.create_connection((ip.strip(), port), timeout).close()
+            return
+        except OSError as e:
+            errors.append(f"{ip.strip()}:{port}: {e}")
+    raise RuntimeError(
+        "axon TPU relay unreachable (" + "; ".join(errors) + "); "
+        "refusing to hang on device init"
+    )
 
 
 def enable_persistent_cache(path: str | None = None) -> None:

@@ -52,6 +52,9 @@ def main() -> None:
     ap.add_argument("--chunk", type=int, default=4096)
     ap.add_argument("--kernel", default="pallas", choices=["w4", "pallas"])
     ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument(
+        "--cpu", action="store_true", help="CPU smoke run (forces w4 kernel)"
+    )
     args = ap.parse_args()
 
     import jax
@@ -61,6 +64,13 @@ def main() -> None:
     from hotstuff_tpu.ops import ed25519 as ed
 
     enable_persistent_cache()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+        args.kernel = "w4"
+    else:
+        from hotstuff_tpu.ops import check_axon_relay
+
+        check_axon_relay()  # fail fast instead of hanging on device init
     from __graft_entry__ import _signed_batch
 
     print(f"# devices: {jax.devices()}")

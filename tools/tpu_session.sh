@@ -9,12 +9,32 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p data/device
-stamp=$(date +%H%M%S)
+stamp=$(date +%Y%m%d_%H%M%S)
 out="data/device/session_$stamp"
 mkdir -p "$out"
 
-if ! timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/8082" 2>/dev/null; then
+# This script exists to capture DEVICE measurements: refuse to run at all
+# without the tunnel env (otherwise jax silently falls back to CPU and
+# 20+ minutes of CPU rates get recorded as device data).
+if [ -z "${PALLAS_AXON_POOL_IPS:-}" ]; then
+  echo "PALLAS_AXON_POOL_IPS unset — not a TPU-tunnel shell; aborting" >&2
+  exit 1
+fi
+# Same probe the benchmarks use: tries every pool IP, respects an
+# explicit non-axon JAX_PLATFORMS.
+if ! python -c "from hotstuff_tpu.ops import check_axon_relay; check_axon_relay()"; then
   echo "relay unreachable; aborting" >&2
+  exit 1
+fi
+# Positive device check: the first benchmark aborts the session unless
+# jax actually reports a non-CPU device.
+if ! timeout 600 python -c "
+import jax
+devs = jax.devices()
+print('devices:', devs)
+assert not all(d.platform == 'cpu' for d in devs), devs
+"; then
+  echo "no accelerator visible to jax; aborting" >&2
   exit 1
 fi
 

@@ -9,7 +9,8 @@ Sections (each skippable):
                table build vs ladder vs compress (where the non-ladder 14%
                of ops actually lands in wall-clock)
   --field      f32 radix-256 vs u32 radix-2^12 field sqr-chain rate
-  --chunks     e2e rate vs pipeline chunk size (2048/4096/8192)
+  --chunks     e2e rate vs pipeline chunk size (2048/4096/8192, plus a
+               single-dispatch 16384-chunk/16384-bucket config)
   --dh         device-hash vs host-hash packed e2e comparison
 
 Usage: python tools/tune_device.py [--all] [--vpu] [--phases] ...
@@ -236,6 +237,10 @@ def main() -> None:
         # The axon hook force-sets JAX_PLATFORMS=axon at import; smoke runs
         # must override AFTER import (same dance as tests/conftest.py).
         jax.config.update("jax_platforms", "cpu")
+    else:
+        from hotstuff_tpu.ops import check_axon_relay
+
+        check_axon_relay()  # fail fast instead of hanging on device init
     print(f"# devices: {jax.devices()}")
     if args.all or args.vpu:
         bench_vpu()
