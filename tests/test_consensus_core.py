@@ -271,3 +271,45 @@ def test_respammed_proposal_does_not_suppress_timeout(run_async, base_port):
         assert saw_timeout, "replica's round-1 timeout was suppressed by spam"
 
     run_async(body())
+
+
+def test_sync_request_flood_does_not_suppress_timeout(run_async, base_port):
+    """Byzantine liveness (ADVICE r3): a peer continuously spraying cheap
+    valid messages must not starve the pacemaker — the expired timer is
+    served within the selector's starvation bound and the Timeout still
+    broadcasts."""
+
+    from hotstuff_tpu.consensus.messages import SyncRequest
+    from hotstuff_tpu.crypto import Digest
+
+    async def body():
+        cmt = committee(base_port)
+        core, core_channel, network_tx, _ = make_core(2, cmt, timeout_ms=150)
+        spawn(core.run())
+
+        requester = keys()[1][0]
+
+        async def flood():
+            # keep the message branch continuously ready
+            while True:
+                await core_channel.put(
+                    SyncRequest(Digest.of(b"missing"), requester)
+                )
+                await asyncio.sleep(0)
+
+        task = spawn(flood())
+        try:
+            # The flooded requests are dropped silently (unknown digest),
+            # so the ONLY message that can appear is the Timeout itself.
+            try:
+                msg = await asyncio.wait_for(network_tx.get(), 8.0)
+            except asyncio.TimeoutError:
+                raise AssertionError(
+                    "pacemaker starved by SyncRequest flood"
+                ) from None
+            out = decode_consensus_message(msg.data)
+            assert isinstance(out, Timeout) and out.round == 1
+        finally:
+            task.cancel()
+
+    run_async(body())
