@@ -714,15 +714,19 @@ class Ed25519TpuVerifier:
             spans.append((lo, hi, width))
         masks = [f.result() for f in futs]
         out = np.empty(n, bool)
-        if len(masks) == 1:
-            full = np.asarray(masks[0])
-        else:
-            full = np.asarray(jnp.concatenate(masks))
+        full = self._materialize(masks)
         off = 0
         for (lo, hi, width), ok in zip(spans, oks):
             out[lo:hi] = full[off : off + hi - lo] & ok
             off += width
         return out
+
+    def _materialize(self, masks) -> np.ndarray:
+        """Device mask handles -> one host bool array (overridden by the
+        mesh verifier: a multi-process mesh needs an allgather first)."""
+        if len(masks) == 1:
+            return np.asarray(masks[0])
+        return np.asarray(jnp.concatenate(masks))
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)

@@ -203,6 +203,10 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
         super().__init__(**kw)
         self.mesh = mesh or default_mesh()
         self._ndev = int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names]))
+        me = jax.process_index()
+        self._multiprocess = any(
+            d.process_index != me for d in np.asarray(self.mesh.devices).flat
+        )
         # per-device shard keeps full lanes (and pallas BLOCK alignment)
         lane = 128
         if self.kernel == "pallas":
@@ -237,6 +241,20 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     def _packed_dh_fn(self):
         return self._sharded_packed_dh
 
+    def _materialize(self, masks) -> np.ndarray:
+        """Multi-host mesh: the mask is sharded across PROCESSES, so a
+        plain np.asarray raises ('spans non-addressable devices'); gather
+        the global value first. Every process calls verify_batch_mask with
+        the same inputs (SPMD), so the allgather is collective-safe."""
+        full = masks[0] if len(masks) == 1 else jnp.concatenate(masks)
+        if self._multiprocess:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(full, tiled=True)
+            )
+        return np.asarray(full)
+
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)
         staged = ed.prepare_batch(
@@ -244,4 +262,4 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
         )
         width = self._bucket(n)
         mask, _ = self._fn(*ed.kernel_args(staged, width, self.kernel))
-        return np.asarray(mask)[:n] & staged["s_ok"]
+        return self._materialize([mask])[:n] & staged["s_ok"]
