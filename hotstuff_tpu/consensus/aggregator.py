@@ -69,8 +69,9 @@ class Aggregator:
 
     def add_vote(self, vote: Vote) -> QC | None:
         """May raise ConsensusError on Byzantine input (duplicate author).
-        TODO parity note: like the reference (aggregator.rs:29-30), a bad node
-        could grow this map; cleanup() bounds it per round advance."""
+        Parity note: like the reference (its aggregator.rs:29-30 TODO), a
+        bad node could grow this map; cleanup() bounds it per round
+        advance."""
         key = (vote.round, vote.hash)
         maker = self.votes_aggregators.setdefault(key, QCMaker())
         return maker.append(vote, self.committee)
