@@ -1,0 +1,196 @@
+"""Probe the device-hash failure latch on real hardware.
+
+The production verifier (`hotstuff_tpu/ops/ed25519.py` Ed25519TpuVerifier)
+computes SHA-512+mod-L on device when every message is a 32-byte digest,
+and latches that fast path off for the life of the verifier if the kernel
+fails where host hashing succeeds.  Until round 5 this behavior was only
+exercised under the CPU interpreter (tests/test_sha512_device.py); this
+tool runs the same scenarios against the live backend and records what
+happened, so the latch's device behavior is captured data rather than an
+assumption.
+
+Three phases:
+  1. organic  — valid + adversarial 32-byte-digest batches through the
+                device-hash path; record whether the latch ever fires on
+                real inputs (expected: it does not).
+  2. forced   — monkeypatch the device-hash jitted fn to raise, confirm
+                the batch still returns correct masks via the host-hash
+                retry and the latch ends OFF (deterministic-failure
+                contract).
+  3. transient— monkeypatch BOTH paths to raise once, confirm the
+                exception propagates and the latch stays ON (transient-
+                outage contract: no permanent downgrade).
+
+Prints one JSON line per phase and a final summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from __graft_entry__ import _signed_batch
+from hotstuff_tpu.ops import ed25519 as ed
+
+
+def _batch(n: int, corrupt_every: int = 0):
+    """n (digest-message, per-item key, sig) triples; every
+    `corrupt_every`-th sig is flipped (0 = none)."""
+    msgs, keys, sigs = _signed_batch(n, msg_len=32, seed=7)
+    sigs = [bytearray(s) for s in sigs]
+    expect = []
+    for i in range(n):
+        ok = True
+        if corrupt_every and i % corrupt_every == 0:
+            sigs[i][0] ^= 0xFF
+            ok = False
+        expect.append(ok)
+    return msgs, keys, [bytes(s) for s in sigs], np.asarray(expect)
+
+
+def phase_organic(v) -> dict:
+    t0 = time.perf_counter()
+    fired = False
+    checked = 0
+    for corrupt in (0, 3):
+        msgs, keys, sigs, expect = _batch(512, corrupt)
+        mask = v.verify_batch_mask(msgs, keys, sigs)
+        assert (mask == expect).all(), "mask mismatch on organic batch"
+        checked += len(msgs)
+        fired = fired or not v._device_hash_ok
+    # Non-canonical / torsion-y junk: random bytes as keys and sigs must
+    # verify False, not crash, and must not trip the latch.
+    rng = np.random.default_rng(99)
+    junk_m = [rng.bytes(32) for _ in range(256)]
+    junk_k = [rng.bytes(32) for _ in range(256)]
+    junk_s = [rng.bytes(64) for _ in range(256)]
+    mask = v.verify_batch_mask(junk_m, junk_k, junk_s)
+    assert not mask.any(), "junk inputs verified True"
+    checked += 256
+    fired = fired or not v._device_hash_ok
+    return {
+        "phase": "organic",
+        "inputs_checked": checked,
+        "latch_fired": fired,
+        "latch_state_ok": v._device_hash_ok,
+        "secs": round(time.perf_counter() - t0, 3),
+    }
+
+
+def phase_forced(v) -> dict:
+    """Deterministic kernel failure: device-hash fn raises, host path
+    works -> batch succeeds via retry, latch ends OFF."""
+    t0 = time.perf_counter()
+    real = v._packed_dh_fn
+
+    def boom():
+        def fn(*a, **k):
+            raise RuntimeError("synthetic device-hash kernel failure")
+
+        return fn
+
+    v._packed_dh_fn = boom
+    try:
+        msgs, keys, sigs, expect = _batch(256, corrupt_every=5)
+        mask = v.verify_batch_mask(msgs, keys, sigs)
+        correct = bool((mask == expect).all())
+    finally:
+        v._packed_dh_fn = real
+    return {
+        "phase": "forced",
+        "mask_correct_via_host_retry": correct,
+        "latch_ended_off": not v._device_hash_ok,
+        "secs": round(time.perf_counter() - t0, 3),
+    }
+
+
+def phase_transient(v) -> dict:
+    """Both paths raise (simulated device outage): the exception must
+    propagate and the latch must stay wherever it was (no downgrade)."""
+    t0 = time.perf_counter()
+    v._device_hash_ok = True  # re-arm after phase_forced
+    real_dh, real_plain = v._packed_dh_fn, v._packed_fn
+
+    def boom():
+        def fn(*a, **k):
+            raise RuntimeError("synthetic transient outage")
+
+        return fn
+
+    v._packed_dh_fn = boom
+    v._packed_fn = boom
+    raised = False
+    try:
+        msgs, keys, sigs, _ = _batch(128)
+        try:
+            v.verify_batch_mask(msgs, keys, sigs)
+        except RuntimeError:
+            raised = True
+    finally:
+        v._packed_dh_fn, v._packed_fn = real_dh, real_plain
+    return {
+        "phase": "transient",
+        "raised": raised,
+        "latch_survived_on": v._device_hash_ok,
+        "secs": round(time.perf_counter() - t0, 3),
+    }
+
+
+def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--cpu", action="store_true", help="run on the CPU interpreter"
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        # The axon hook force-sets JAX_PLATFORMS=axon at import; override
+        # AFTER import (same dance as tests/conftest.py).
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        from hotstuff_tpu.ops import check_axon_relay
+
+        check_axon_relay()  # fail fast instead of hanging on device init
+
+    platforms = sorted({d.platform for d in jax.devices()})
+    # Same selection rule as the production TpuBackend
+    # (crypto/tpu_backend.py:58): pallas on an accelerator, the jnp w4
+    # kernel on the CPU interpreter (pallas has no CPU lowering).
+    kernel = "w4" if jax.default_backend() == "cpu" else "pallas"
+    v = ed.Ed25519TpuVerifier(kernel=kernel)
+    results = [phase_organic(v), phase_forced(v), phase_transient(v)]
+    for r in results:
+        print(json.dumps(r))
+    ok = (
+        not results[0]["latch_fired"]
+        and results[1]["mask_correct_via_host_retry"]
+        and results[1]["latch_ended_off"]
+        and results[2]["raised"]
+        and results[2]["latch_survived_on"]
+    )
+    print(
+        json.dumps(
+            {
+                "summary": "latch_probe",
+                "platforms": platforms,
+                "kernel": kernel,
+                "organic_latch_fired": results[0]["latch_fired"],
+                "contracts_held": ok,
+            }
+        )
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,17 +1,17 @@
 #!/bin/bash
-# One-shot TPU measurement session for the round-4 perf work.
+# One-shot TPU measurement session.
 # Run when the axon relay (127.0.0.1:8082) is reachable; captures every
 # microbenchmark + the driver benchmarks into data/device/.
 #
 #   bash tools/tpu_session.sh
 #
 # Keep the host otherwise IDLE (1 vCPU: concurrent work corrupts timings).
+#
+# Hygiene contract (round-5): ALL preflight checks run before the session
+# directory is created, and an aborted capture removes its directory —
+# an existing data/device/session_*/ always holds real captured data.
 set -u
 cd "$(dirname "$0")/.."
-mkdir -p data/device
-stamp=$(date +%Y%m%d_%H%M%S)
-out="data/device/session_$stamp"
-mkdir -p "$out"
 
 # This script exists to capture DEVICE measurements: refuse to run at all
 # without the tunnel env (otherwise jax silently falls back to CPU and
@@ -26,24 +26,88 @@ if ! python -c "from hotstuff_tpu.ops import check_axon_relay; check_axon_relay(
   echo "relay unreachable; aborting" >&2
   exit 1
 fi
-# Positive device check: the first benchmark aborts the session unless
-# jax actually reports a non-CPU device.
-if ! timeout 600 python -c "
+# Positive device check BEFORE any directory exists: the session aborts
+# unless jax actually reports a non-CPU device. Also snapshots the
+# environment for SESSION.json.
+if ! session_meta=$(timeout 600 python -c "
+import json, os, sys
 import jax
 devs = jax.devices()
-print('devices:', devs)
-assert not all(d.platform == 'cpu' for d in devs), devs
-"; then
+if all(d.platform == 'cpu' for d in devs):
+    sys.exit('no accelerator visible to jax: %r' % (devs,))
+print(json.dumps({
+    'jax': jax.__version__,
+    'devices': [str(d) for d in devs],
+    'platform': jax.default_backend(),
+    'tpu_gen': os.environ.get('PALLAS_AXON_TPU_GEN', ''),
+    'pool_ips': os.environ.get('PALLAS_AXON_POOL_IPS', ''),
+}))
+"); then
   echo "no accelerator visible to jax; aborting" >&2
   exit 1
 fi
+# Last stdout line only: an import-time banner must not corrupt SESSION.json.
+session_meta=$(printf '%s\n' "$session_meta" | tail -1)
+if [ -z "$session_meta" ]; then
+  echo "device check produced no metadata; aborting" >&2
+  exit 1
+fi
+
+stamp=$(date +%Y%m%d_%H%M%S)
+out="data/device/session_$stamp"
+mkdir -p "$out"
+# If the capture dies before finishing, leave no half-empty session dir
+# behind (round-4 left an empty session_20260730_155646/ that read as
+# captured-but-lost data). A completed run clears the trap.
+ok_count=0
+fail_count=0
+current=""
+cleanup() {
+  # An in-flight benchmark's partial output must never sit beside real
+  # captures unmarked.
+  if [ -n "$current" ] && [ -f "$out/$current.txt" ]; then
+    mv "$out/$current.txt" "$out/$current.INTERRUPTED.txt"
+  fi
+  if [ "$ok_count" -eq 0 ]; then
+    if [ -n "$(find "$out" \( -name '*.FAILED.txt' -o -name '*.INTERRUPTED.txt' \) -print -quit 2>/dev/null)" ]; then
+      # Keep failure tracebacks for diagnosis, but under a name that can
+      # never read as captured data.
+      echo "session aborted with only failures; keeping logs in failed_session_$stamp" >&2
+      mv "$out" "data/device/failed_session_$stamp"
+    else
+      echo "session aborted with nothing captured; removing $out" >&2
+      rm -rf "$out"
+    fi
+  else
+    echo "session aborted after $ok_count captures; keeping $out (marked ABORTED)" >&2
+    echo "aborted after $ok_count ok / $fail_count failed" > "$out/ABORTED"
+  fi
+}
+trap cleanup EXIT
+trap 'cleanup; trap - EXIT; exit 130' INT TERM
+echo "$session_meta" > "$out/SESSION.json"
 
 run() {
   name=$1; shift
+  current=$name
   echo "=== $name: $*"
   timeout 1200 "$@" > "$out/$name.txt" 2>&1
-  echo "--- rc=$? tail:"
+  rc=$?
+  current=""
+  echo "--- rc=$rc tail:"
   tail -5 "$out/$name.txt"
+  if [ "$rc" -eq 0 ]; then
+    ok_count=$((ok_count + 1))
+  else
+    fail_count=$((fail_count + 1))
+    mv "$out/$name.txt" "$out/$name.FAILED.txt"
+    # A dead relay makes every later benchmark burn its full timeout;
+    # fail fast instead of capturing 3 hours of tracebacks.
+    if ! python -c "from hotstuff_tpu.ops import check_axon_relay; check_axon_relay()" 2>/dev/null; then
+      echo "relay lost mid-session after $name; aborting" >&2
+      exit 1
+    fi
+  fi
 }
 
 run tune_vpu    python tools/tune_device.py --vpu
@@ -51,8 +115,17 @@ run tune_field  python tools/tune_device.py --field
 run tune_phases python tools/tune_device.py --phases
 run tune_chunks python tools/tune_device.py --chunks
 run tune_dh     python tools/tune_device.py --dh
+run latch_probe python tools/latch_probe.py
 run profile_e2e python tools/profile_e2e.py
 run bench       python bench.py
 run bench_mesh  python bench.py --mesh
 run committee   python bench.py --committee-scale
-echo "session captured in $out"
+trap - EXIT INT TERM
+if [ "$ok_count" -eq 0 ]; then
+  echo "session FAILED: no benchmark succeeded; keeping logs in failed_session_$stamp" >&2
+  mv "$out" "data/device/failed_session_$stamp"
+  exit 1
+fi
+echo "captured $ok_count ok / $fail_count failed" > "$out/STATUS"
+echo "session captured in $out ($ok_count ok, $fail_count failed)"
+[ "$fail_count" -eq 0 ] || exit 2
