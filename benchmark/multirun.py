@@ -1,0 +1,98 @@
+"""Repeated-run driver: N local benchmark runs of one config, aggregated.
+
+The reference's committed result files hold repeated runs appended to one
+file (e.g. data/2-chain/results/bench-10-70000-512-0.txt), and its
+aggregate.py averages them. Here each run writes its own
+``bench-<nodes>-<rate>-<size>-<faults>-run<i>.txt`` into ``--outdir`` and
+``benchmark.aggregate`` produces mean ± stdev with real run counts — on a
+noisy shared host single-run numbers are not evidence.
+
+    python -m benchmark.multirun --nodes 4 --rate 3000 --size 512 \
+        --duration 120 --runs 3 --crypto cpu --benchmark-workload \
+        --outdir data/local/multirun_r05
+
+Runs are sequential (1 vCPU: concurrent committees corrupt each other's
+timings) with a settle pause between them.
+
+NOTE: the aggregator groups by (nodes, faults, tx_size, rate) only — runs
+of the same shape with different crypto backends or workload flags must go
+in SEPARATE --outdir directories or they average together.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import time
+from os.path import join
+
+from .aggregate import aggregate_results
+from .fabfile import LOCAL_NODE_PARAMS
+from .local import BenchError, LocalBench
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument("--size", type=int, default=512)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--duration", type=int, default=60)
+    p.add_argument("--runs", type=int, default=3)
+    p.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument("--benchmark-workload", action="store_true")
+    p.add_argument("--timeout-delay", type=int, default=None)
+    p.add_argument("--outdir", default="data/local/multirun")
+    p.add_argument("--tag", default="",
+                   help="suffix for result filenames (e.g. 'tpu-workload')")
+    p.add_argument("--settle", type=int, default=5,
+                   help="seconds between runs (let sockets/process slots drain)")
+    args = p.parse_args()
+
+    os.makedirs(args.outdir, exist_ok=True)
+    bench_params = {
+        "nodes": args.nodes,
+        "rate": args.rate,
+        "tx_size": args.size,
+        "faults": args.faults,
+        "duration": args.duration,
+        "crypto": args.crypto,
+    }
+    node_params = {k: dict(v) for k, v in LOCAL_NODE_PARAMS.items()}
+    if args.benchmark_workload:
+        node_params["mempool"]["benchmark_mode"] = True
+    if args.timeout_delay is not None:
+        node_params["consensus"]["timeout_delay"] = args.timeout_delay
+
+    tag = f"-{args.tag}" if args.tag else ""
+    done = 0
+    for i in range(args.runs):
+        name = (
+            f"bench-{args.nodes}-{args.rate}-{args.size}-{args.faults}"
+            f"{tag}-run{i}.txt"
+        )
+        print(f"--- run {i + 1}/{args.runs}: {name}")
+        try:
+            parser = LocalBench(bench_params, node_params).run()
+        except BenchError as e:
+            # One failed run must not discard the others; the aggregate's
+            # run count states how many succeeded.
+            print(f"run {i} failed: {e}")
+            continue
+        result = parser.result()
+        print(result)
+        with open(join(args.outdir, name), "w") as f:
+            f.write(result)
+        done += 1
+        if i + 1 < args.runs:
+            time.sleep(args.settle)
+
+    if done:
+        aggregate_results(args.outdir)
+        print(f"aggregated {done}/{args.runs} runs into {args.outdir}/aggregated.txt")
+    else:
+        raise SystemExit("every run failed")
+
+
+if __name__ == "__main__":
+    main()

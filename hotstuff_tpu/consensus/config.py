@@ -90,6 +90,16 @@ class Parameters:
     sync_retry_delay: int = 10_000  # ms between sync request retries
     max_payload_size: int = 500  # max bytes of payload digests per block
     min_block_delay: int = 100  # ms minimum spacing between blocks
+    # Pacemaker exponential backoff (a liveness improvement over the
+    # reference's fixed delay, consensus/src/timer.rs): each consecutive
+    # local timeout multiplies the delay by `timeout_backoff` up to
+    # `max_timeout_delay`; any QC that advances the round restores
+    # `timeout_delay`. Under sustained overload a fixed pacemaker fires
+    # storms of Timeout/TC work that compound the overload (246 timeouts in
+    # the round-4 300 s saturation run); backoff lets the backlog drain.
+    # 1.0 disables backoff (reference behavior).
+    timeout_backoff: float = 2.0
+    max_timeout_delay: int = 30_000  # ms cap for the backed-off delay
 
     def log(self, log) -> None:
         # NOTE: these log entries are parsed by the benchmark LogParser.
@@ -97,6 +107,7 @@ class Parameters:
         log.info("Sync retry delay set to %s ms", self.sync_retry_delay)
         log.info("Max payload size set to %s B", self.max_payload_size)
         log.info("Min block delay set to %s ms", self.min_block_delay)
+        log.info("Timeout backoff set to %s", self.timeout_backoff)
 
     def to_json(self) -> dict:
         return {
@@ -104,6 +115,8 @@ class Parameters:
             "sync_retry_delay": self.sync_retry_delay,
             "max_payload_size": self.max_payload_size,
             "min_block_delay": self.min_block_delay,
+            "timeout_backoff": self.timeout_backoff,
+            "max_timeout_delay": self.max_timeout_delay,
         }
 
     @staticmethod

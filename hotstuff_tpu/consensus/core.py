@@ -95,6 +95,9 @@ class Core:
         self.high_qc: QC = QC.genesis()
         self.aggregator = Aggregator(committee)
         self.timer: Timer | None = None  # created inside the running loop
+        # Pacemaker backoff state: consecutive local timeouts without an
+        # intervening QC-driven round advance (see Parameters.timeout_backoff).
+        self._consecutive_timeouts = 0
 
     # -- persistence of safety-critical state (fixes reference issue #15) ----
 
@@ -198,6 +201,13 @@ class Core:
 
     async def _process_qc(self, qc: QC) -> None:
         """Adopt a higher QC and advance past its round (core.rs:263-276,321)."""
+        if qc.round >= self.round and self._consecutive_timeouts:
+            # A QC advancing the round is real progress: restore the base
+            # pacemaker delay. (TC-driven advances deliberately keep the
+            # backed-off delay — a timeout round is not progress.)
+            self._consecutive_timeouts = 0
+            if self.timer is not None:
+                self.timer.set_delay_ms(self.parameters.timeout_delay)
         await self._advance_round(qc.round)
         if qc.round > self.high_qc.round:
             self.high_qc = qc
@@ -226,6 +236,24 @@ class Core:
         )
         timeout = Timeout(self.high_qc, self.round, self.name, signature)
         if self.timer is not None:
+            # Exponential backoff (liveness only — timeouts carry no safety
+            # weight): under overload, firing at a fixed cadence adds
+            # Timeout/TC verification storms to the very backlog that caused
+            # the timeout. Growth starts at the THIRD consecutive timeout:
+            # a single crashed leader inherently stalls two rounds per
+            # rotation (the round whose votes it should collect, then its
+            # own round), and backing off inside that ordinary 2-timeout
+            # cycle would tax every crash-fault view change; only longer
+            # chains (overload, partition) see growing delays. Restored by
+            # the next QC-driven advance.
+            self._consecutive_timeouts += 1
+            p = self.parameters
+            delay = min(
+                p.timeout_delay
+                * (p.timeout_backoff ** max(0, self._consecutive_timeouts - 2)),
+                p.max_timeout_delay,
+            )
+            self.timer.set_delay_ms(max(delay, p.timeout_delay))
             self.timer.reset()
         await self._transmit(timeout, None)
         await self._handle_timeout(timeout)

@@ -279,31 +279,17 @@ async def _handle_connection(reader, writer, service, urgent_below: int):
         writer.close()
 
 
-def warmup_backend(backend: CryptoBackend, max_batch: int = 8192) -> None:
+def warmup_backend(backend: CryptoBackend) -> None:
     """Pre-compile every verifier bucket width BEFORE serving: a cold jit
     specialisation (~20-40 s on TPU) hitting mid-run would stall the whole
     committee's verification pipeline. With the persistent compilation cache
-    enabled this is fast on every boot after the first."""
-    import random
-
-    from .primitives import Digest, Signature, generate_keypair
-
-    verifier = getattr(backend, "_verifier", None)
-    if verifier is None:
-        return
-    rng = random.Random(11)
-    pk, sk = generate_keypair(rng)
-    digest = Digest.of(b"warmup")
-    sig = Signature.new(digest, sk)
-    width = getattr(verifier, "min_bucket", 128)
-    while True:
-        log.info("warmup: compiling bucket width %s", width)
-        backend.verify_batch_mask(
-            [digest.data] * width, [pk] * width, [sig] * width
-        )
-        if width >= max_batch or width >= verifier.max_bucket:
-            break
-        width *= 2
+    enabled this is fast on every boot after the first. Delegates to the
+    backend's own warmup (TpuBackend.warmup covers the device-hash AND
+    host-hash variants); backends without one (CpuBackend) need none."""
+    warm = getattr(backend, "warmup", None)
+    if warm is not None:
+        secs = warm()
+        log.info("backend warmup finished in %.1f s", secs)
 
 
 async def serve(
@@ -388,7 +374,7 @@ def main(argv: list[str] | None = None) -> None:
 
     quiet_jax_logs(args.verbose)
     if not args.no_warmup:
-        warmup_backend(backend, args.max_batch)
+        warmup_backend(backend)
         quiet_jax_logs(args.verbose)  # device init may reconfigure logging
     asyncio.run(
         serve(

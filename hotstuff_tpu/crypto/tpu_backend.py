@@ -64,6 +64,46 @@ class TpuBackend(CryptoBackend):
         self._lock = threading.Lock()
         self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
+    def warmup(self) -> float:
+        """Force-compile every device bucket shape the verifier dispatches at
+        runtime, BEFORE the node joins consensus. The first dispatch at each
+        bucket width triggers XLA compilation (tens of seconds cold); paying
+        that lazily inside the protocol stalls rounds past timeout_delay and
+        fires the pacemaker (the round-4 saturation runs logged dozens of
+        boot-window timeouts). With the persistent compile cache enabled in
+        __init__, later processes and runs hit the on-disk cache and this
+        costs seconds. Returns wall seconds spent.
+
+        Junk inputs are used on purpose: compilation is shape-dependent
+        only, and the masks are discarded. 32-byte messages warm the
+        production device-hash path; one 33-byte batch at the largest width
+        warms the host-hash variant the failure latch falls back to.
+        """
+        import os
+        import time
+
+        t0 = time.perf_counter()
+        v = self._verifier
+        widths, w = [], v.min_bucket
+        top = min(v.chunk, v.max_bucket) if hasattr(v, "chunk") else v.max_bucket
+        while w < top:
+            widths.append(w)
+            w *= 2
+        # The largest shape actually dispatched for a full chunk (bucket
+        # rounding may exceed `top` when min_bucket isn't a power of two).
+        widths.append(v._bucket(top))
+        for width in widths:
+            junk_m = [os.urandom(32)] * width
+            junk_k = [os.urandom(32)] * width
+            junk_s = [os.urandom(64)] * width
+            v.verify_batch_mask(junk_m, junk_k, junk_s)
+        v.verify_batch_mask(
+            [os.urandom(33)] * widths[-1],
+            [os.urandom(32)] * widths[-1],
+            [os.urandom(64)] * widths[-1],
+        )
+        return time.perf_counter() - t0
+
     def verify_batch_mask(
         self,
         messages: Sequence[bytes],

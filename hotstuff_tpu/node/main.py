@@ -39,7 +39,16 @@ async def _run_node(args) -> None:
             host, port = args.crypto_addr.rsplit(":", 1)
             kwargs["addr"] = (host, int(port))
             kwargs["crossover"] = args.crypto_crossover
-        set_backend(make_backend(args.crypto, **kwargs))
+        backend = make_backend(args.crypto, **kwargs)
+        set_backend(backend)  # returns the PREVIOUS backend — don't chain
+        if not args.no_warmup:
+            # Compile every device bucket BEFORE the pacemaker can arm:
+            # lazy first-dispatch compilation (tens of seconds) otherwise
+            # stalls early rounds past timeout_delay (see
+            # TpuBackend.warmup). Runs before boot(), so nothing is stalled.
+            from ..crypto.remote import warmup_backend
+
+            warmup_backend(backend)
     node = Node(args.committee, args.keys, args.store, args.parameters)
     node.boot()
     await node.analyze_block()
@@ -126,6 +135,11 @@ def main(argv: list[str] | None = None) -> None:
         type=int,
         default=64,
         help="batches below this size verify on the local CPU",
+    )
+    p_run.add_argument(
+        "--no-warmup",
+        action="store_true",
+        help="skip pre-compiling device kernels before joining consensus",
     )
 
     p_deploy = sub.add_parser("deploy", help="in-process local testbed")

@@ -161,11 +161,28 @@ class Timer:
     def __init__(self, delay_ms: int) -> None:
         self._delay = delay_ms / 1000.0
         self._deadline = 0.0
+        self._moved: asyncio.Event | None = None
         self.reset()
 
     def reset(self) -> None:
         loop = asyncio.get_event_loop()
         self._deadline = loop.time() + self._delay
+        # Wake pending waiters: an in-flight sleep targets the OLD deadline,
+        # and if the new one is EARLIER (pacemaker backoff shrinking the
+        # delay back to base) the waiter would silently oversleep by the
+        # difference. Waiters re-check the fresh deadline and re-sleep.
+        if self._moved is not None:
+            moved, self._moved = self._moved, None
+            moved.set()
+
+    def set_delay_ms(self, delay_ms: float) -> None:
+        """Change the delay applied by FUTURE reset() calls (pacemaker
+        backoff); the current deadline is untouched."""
+        self._delay = delay_ms / 1000.0
+
+    @property
+    def delay_ms(self) -> float:
+        return self._delay * 1000.0
 
     def expired(self) -> bool:
         """True iff the CURRENT deadline has passed. Consumers multiplexing
@@ -180,4 +197,10 @@ class Timer:
             remaining = self._deadline - loop.time()
             if remaining <= 0:
                 return
-            await asyncio.sleep(remaining)
+            if self._moved is None:
+                self._moved = asyncio.Event()
+            moved = self._moved
+            try:
+                await asyncio.wait_for(moved.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass  # deadline may have moved either way; loop re-checks

@@ -313,3 +313,72 @@ def test_sync_request_flood_does_not_suppress_timeout(run_async, base_port):
             task.cancel()
 
     run_async(body())
+
+
+def test_pacemaker_backoff_grows_caps_and_resets(run_async, base_port):
+    """Consecutive local timeouts back the pacemaker delay off exponentially
+    (capped); a QC that advances the round restores the base delay. Backoff
+    is liveness-only: it never changes WHAT is sent, only when the next
+    timeout fires."""
+
+    async def body():
+        cmt = committee(base_port)
+        core, _core_channel, network_tx, _ = make_core(0, cmt, timeout_ms=100)
+        core.parameters.timeout_backoff = 2.0
+        core.parameters.max_timeout_delay = 500
+        from hotstuff_tpu.utils.actors import Timer
+
+        core.timer = Timer(core.parameters.timeout_delay)
+        assert core.timer.delay_ms == 100
+
+        # Growth starts at the THIRD consecutive timeout: a single crashed
+        # leader stalls two rounds per rotation, which must not be taxed.
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 100
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 100
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 200
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 400
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 500  # capped
+        await core._local_timeout_round()
+        assert core.timer.delay_ms == 500
+
+        # Each timeout still broadcast a Timeout message (6 total).
+        for _ in range(6):
+            msg = await asyncio.wait_for(network_tx.get(), 5)
+            assert isinstance(decode_consensus_message(msg.data), Timeout)
+
+        # A QC advancing the round restores the base delay...
+        qc = qc_for(chain(1, cmt)[0])
+        await core._process_qc(qc)
+        assert core.timer.delay_ms == 100
+        assert core._consecutive_timeouts == 0
+
+        # ...but a STALE QC after new timeouts must not.
+        for _ in range(3):
+            await core._local_timeout_round()
+        assert core.timer.delay_ms == 200
+        await core._process_qc(qc)  # qc.round < core.round now
+        assert core.timer.delay_ms == 200
+
+    run_async(body())
+
+
+def test_pacemaker_backoff_disabled_matches_reference(run_async, base_port):
+    """timeout_backoff=1.0 keeps the fixed-delay reference behavior."""
+
+    async def body():
+        cmt = committee(base_port)
+        core, _cc, network_tx, _ = make_core(0, cmt, timeout_ms=100)
+        core.parameters.timeout_backoff = 1.0
+        from hotstuff_tpu.utils.actors import Timer
+
+        core.timer = Timer(core.parameters.timeout_delay)
+        for _ in range(3):
+            await core._local_timeout_round()
+        assert core.timer.delay_ms == 100
+
+    run_async(body())

@@ -44,3 +44,22 @@ def test_timer_repeated_resets_then_fire(run_async):
         await asyncio.wait_for(waiter, 5)
 
     run_async(body())
+
+
+def test_timer_reset_to_shorter_delay_wakes_early(run_async):
+    """A waiter armed while the delay was long must fire at the NEW, EARLIER
+    deadline after set_delay_ms + reset (pacemaker backoff shrinking back to
+    base) — not oversleep to the old one."""
+
+    async def body():
+        timer = Timer(5_000)
+        waiter = asyncio.ensure_future(timer.wait())
+        await asyncio.sleep(0.05)  # waiter now sleeping toward +5s
+        timer.set_delay_ms(100)
+        timer.reset()  # deadline moves EARLIER: +100ms from now
+        t0 = time.monotonic()
+        await asyncio.wait_for(waiter, 2)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 1.5, f"overslept the shortened deadline: {elapsed}"
+
+    run_async(body())

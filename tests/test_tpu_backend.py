@@ -136,3 +136,21 @@ class TestGraftEntry:
         from __graft_entry__ import dryrun_multichip
 
         dryrun_multichip(8)
+
+
+class TestWarmup:
+    def test_warmup_compiles_every_bucket(self, keys):
+        # Tiny buckets keep the test fast: one dh compile + one host-hash
+        # compile at width 128 (shapes already cached by earlier tests).
+        backend = make_backend(
+            "tpu", crossover=1, min_bucket=128, max_bucket=128
+        )
+        secs = backend.warmup()
+        assert secs > 0
+        # Warmed backend still verifies correctly end to end.
+        pk, sk = keys[0]
+        d = Digest.of(b"warm")
+        sig = Signature.new(d, sk)
+        assert backend.verify_batch_mask([d.data] * 4, [pk] * 4, [sig] * 4) == [
+            True
+        ] * 4
