@@ -33,7 +33,7 @@ class LocalBench:
         self.crypto = bench_params.get("crypto", "cpu")
         self._procs: list[subprocess.Popen] = []
 
-    def _background_run(self, command: str, log_file: str) -> None:
+    def _background_run(self, command: str, log_file: str) -> subprocess.Popen:
         with open(log_file, "w") as out:
             proc = subprocess.Popen(
                 shlex.split(command),
@@ -43,6 +43,32 @@ class LocalBench:
                 start_new_session=True,
             )
         self._procs.append(proc)
+        return proc
+
+    @staticmethod
+    def _await_in_logs(waits, phrase: str, timeout: float, what: str) -> None:
+        """Block until every (log_path, proc) in `waits` has `phrase` in its
+        log. Fails fast with the real exit code when a process dies during
+        startup instead of burning the timeout on a log line that can never
+        appear."""
+        deadline = time.monotonic() + timeout
+        pending = dict(waits)
+        while pending and time.monotonic() < deadline:
+            time.sleep(0.5)
+            for path, proc in list(pending.items()):
+                if proc.poll() is not None:
+                    raise BenchError(
+                        f"{what} exited at startup "
+                        f"(rc={proc.returncode}); see {path}"
+                    )
+                try:
+                    with open(path) as f:
+                        if phrase in f.read():
+                            del pending[path]
+                except OSError:
+                    pass
+        if pending:
+            raise BenchError(f"{what} never ready: {sorted(pending)}")
 
     def _kill(self) -> None:
         for proc in self._procs:
@@ -86,33 +112,23 @@ class LocalBench:
             if self.crypto == "tpu":
                 sidecar_port = self.BASE_PORT - 100
                 crypto_addr = f"127.0.0.1:{sidecar_port}"
-                self._background_run(
+                sidecar_proc = self._background_run(
                     CommandMaker.run_sidecar(sidecar_port, "tpu", debug=debug),
                     join("logs", "sidecar.log"),
                 )
-                sidecar_proc = self._procs[-1]
                 # JAX/TPU init + per-bucket warmup (even cache-hits pay
                 # ~30 s device program load over a tunneled chip)
-                deadline = time.monotonic() + 480
-                while time.monotonic() < deadline:
-                    if sidecar_proc.poll() is not None:
-                        raise BenchError(
-                            "crypto sidecar exited at startup "
-                            f"(rc={sidecar_proc.returncode}); see logs/sidecar.log"
-                        )
-                    try:
-                        with open(join("logs", "sidecar.log")) as f:
-                            if "successfully booted" in f.read():
-                                break
-                    except OSError:
-                        pass
-                    time.sleep(0.5)
-                else:
-                    raise BenchError("crypto sidecar never booted")
+                self._await_in_logs(
+                    [(join("logs", "sidecar.log"), sidecar_proc)],
+                    "successfully booted",
+                    480,
+                    "crypto sidecar",
+                )
                 node_crypto = "remote"
 
             # Boot nodes (skipping `faults` of them -- fault injection by
             # simply not booting, local.py:75-76).
+            node_waits = []
             for i in range(boot):
                 cmd = CommandMaker.run_node(
                     key_files[i],
@@ -123,30 +139,23 @@ class LocalBench:
                     crypto_addr=crypto_addr,
                     debug=debug,
                 )
-                self._background_run(cmd, CommandMaker.logs_path("logs", "node", i))
+                log_path = CommandMaker.logs_path("logs", "node", i)
+                node_waits.append((log_path, self._background_run(cmd, log_path)))
 
             # Wait until every node reports booted: Python interpreter
             # startup under CPU contention can take ~10 s on small machines,
-            # and killing before boot would measure nothing.
-            deadline = time.monotonic() + 90
-            pending = set(range(boot))
-            while pending and time.monotonic() < deadline:
-                time.sleep(0.5)
-                for i in list(pending):
-                    try:
-                        with open(CommandMaker.logs_path("logs", "node", i)) as f:
-                            if "successfully booted" in f.read():
-                                pending.discard(i)
-                    except OSError:
-                        pass
-            if pending:
-                raise BenchError(f"nodes {sorted(pending)} never booted")
+            # and killing before boot would measure nothing. The timeout
+            # scales with committee size (2n processes share one core).
+            self._await_in_logs(
+                node_waits, "successfully booted", 90 + 6 * boot, "node"
+            )
 
             # One client per booted node.
             per_client_rate = max(1, rate // boot)
             consensus_addrs = [
                 committee.consensus_addr[n] for n in names[:boot]
             ]
+            client_waits = []
             for i in range(boot):
                 cmd = CommandMaker.run_client(
                     committee.front_addr[names[i]],
@@ -154,7 +163,26 @@ class LocalBench:
                     per_client_rate,
                     consensus_addrs,
                 )
-                self._background_run(cmd, CommandMaker.logs_path("logs", "client", i))
+                log_path = CommandMaker.logs_path("logs", "client", i)
+                client_waits.append(
+                    (log_path, self._background_run(cmd, log_path))
+                )
+
+            # Wait until every client is actually sending before starting
+            # the measurement clock: at 2 processes per node on one core,
+            # the last client interpreters can take >60 s to start (at
+            # n=20 the entire 60 s window used to elapse with zero
+            # transactions sent — blocks committed empty and the run
+            # parsed as a zero-TPS "cliff" that was purely boot skew).
+            # LogParser additionally starts its steady-state window at the
+            # LAST client's first send, so any residual skew stays out of
+            # the throughput denominator.
+            self._await_in_logs(
+                client_waits,
+                "Start sending transactions",
+                90 + 6 * boot,
+                "client",
+            )
 
             time.sleep(self.bench.duration)
             self._kill()

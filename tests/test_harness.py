@@ -158,3 +158,45 @@ def test_log_parser_raises_on_crash_lines(bad):
         LogParser([CLIENT_LOG], [NODE_LOG + bad])
     with pytest.raises(ParseError):
         LogParser([CLIENT_LOG + bad], [NODE_LOG])
+
+
+def test_log_parser_steady_state_window_excludes_boot_skew():
+    """On an oversubscribed host the last client may start minutes after the
+    first; throughput must be measured from the LAST client's start, with
+    ramp-period commits excluded from the numerator too."""
+    from benchmark.logs import LogParser
+
+    early_client = CLIENT_LOG  # starts at 10:00:00.002
+    late_client = early_client.replace("10:00:0", "10:01:0")  # starts 60s later
+    # One payload commits during the ramp (before the late client starts),
+    # one after; only the latter counts, over the post-steady window.
+    node = NODE_LOG + (
+        "[2026-07-30T10:01:00.300Z INFO hotstuff.consensus] Created B9(b9=)\n"
+        "[2026-07-30T10:01:02.000Z INFO hotstuff.mempool] Payload xyz= contains 2048 B\n"
+        "[2026-07-30T10:01:02.900Z INFO hotstuff.consensus] Committed B9(b9=)\n"
+        "[2026-07-30T10:01:02.901Z INFO hotstuff.consensus] Committed B9(b9=) -> xyz=\n"
+    )
+    p = LogParser([early_client, late_client], [node])
+    assert p.steady_start == pytest.approx(p.start + 60.0)
+    tps, bps, duration = p.end_to_end_throughput()
+    # window: last client start 10:01:00.002 -> last commit 10:01:02.900
+    assert duration == pytest.approx(2.898, abs=0.01)
+    assert bps == pytest.approx(2048 / 2.898, rel=0.01)  # abc= excluded
+    # consensus window clamps to steady_start as well
+    _, c_bps, c_dur = p.consensus_throughput()
+    assert c_dur == pytest.approx(2.898, abs=0.01)
+    assert c_bps == pytest.approx(2048 / 2.898, rel=0.01)
+    # latency is windowed too: only B9 (proposed in-window, 2.6 s) counts,
+    # not the uncontended ramp block B1 (0.6 s).
+    assert p.consensus_latency() == pytest.approx(2.6)
+
+
+def test_log_parser_single_client_window_unchanged():
+    """With one client (or synchronized starts) steady_start == start and
+    the metrics match the reference semantics."""
+    from benchmark.logs import LogParser
+
+    p = LogParser([CLIENT_LOG], [NODE_LOG])
+    assert p.steady_start == p.start
+    tps, bps, _ = p.end_to_end_throughput()
+    assert bps > 0

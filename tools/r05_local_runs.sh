@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 CPU-side measurement batch: multi-run aggregates for the headline
+# configs plus the committee-scaling sweep. Sequential on purpose (1 vCPU).
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== multirun: 4-node 1k cpu (reference local config) x3"
+python -m benchmark.multirun --nodes 4 --rate 1000 --size 512 --duration 60 \
+  --runs 3 --crypto cpu --outdir data/local/multirun_r05_cpu1k
+
+echo "=== multirun: 4-node 3k cpu-workload (saturation pair, cpu side) x3"
+python -m benchmark.multirun --nodes 4 --rate 3000 --size 512 --duration 120 \
+  --runs 3 --crypto cpu --benchmark-workload --timeout-delay 2500 \
+  --outdir data/local/multirun_r05_cpuwl3k --tag cpu-workload
+
+echo "=== multirun: 10-node f=1 x3"
+python -m benchmark.multirun --nodes 10 --rate 1000 --size 512 --duration 60 \
+  --runs 3 --faults 1 --crypto cpu --outdir data/local/multirun_r05_f1
+
+echo "=== committee sweep n in {4,8,10,13,16,20} @ 500 tx/s x2"
+for n in 4 8 10 13 16 20; do
+  python -m benchmark.multirun --nodes "$n" --rate 500 --size 512 \
+    --duration 60 --runs 2 --crypto cpu --outdir data/local/scaling_r05
+done
+echo "=== done"
