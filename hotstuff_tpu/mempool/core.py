@@ -110,7 +110,16 @@ class Core:
             verification_service or BatchVerificationService()
         )
         self._verify_sem = asyncio.Semaphore(max_inflight_verifications)
+        # Payload ACCEPTANCE (1 urgent signature + store) gets its own,
+        # larger bound: cheap enough that 64 in flight is generous, but a
+        # Byzantine peer streaming payloads must not grow _inflight (and
+        # the heap) without limit. Overflowing gossip is dropped — it is
+        # best-effort by contract; the payload synchronizer recovers any
+        # payload consensus actually needs.
+        self._accept_sem = asyncio.Semaphore(64)
         self._inflight: set[asyncio.Task] = set()
+        self._gossip_dropped = 0  # payloads shed at full acceptance bound
+        self._synthetic_skipped = 0  # workload sigs skipped at a full pipeline
         # Undelivered payload digests, insertion-ordered (core.rs:50 queue).
         self.queue: dict[Digest, None] = {}
         # Digests already consumed by consensus cleanup. Background payload
@@ -144,6 +153,21 @@ class Core:
         NOTE: This log entry is used to compute performance."""
         if self.pool is None or n == 0:
             return
+        if self._verify_sem.locked():
+            # Pure measurement load must never block the core loop: with
+            # the pipeline saturated, admitting another batch would park
+            # this actor on the semaphore and stop it serving
+            # PayloadRequests — the recovery path consensus stalls on.
+            before = self._synthetic_skipped
+            self._synthetic_skipped += n
+            if before // 100_000 != self._synthetic_skipped // 100_000:
+                log.warning(
+                    "verification pipeline saturated: %s synthetic workload "
+                    "signatures skipped so far (measured rate reflects "
+                    "capacity, not demand)",
+                    self._synthetic_skipped,
+                )
+            return
         log.info("Verifying %s transaction batch. Size: %s", kind, n)
         msgs, pairs = self.pool.take(n)
         await self._spawn_verification(self._run_synthetic, msgs, pairs)
@@ -155,24 +179,27 @@ class Core:
         if not all(mask):
             log.error("synthetic batch verification failed (backend bug?)")
 
-    async def _spawn_verification(self, fn, *args) -> None:
-        """Run `fn(*args)` in a background task, capped at
-        `max_inflight_verifications` (acquiring the semaphore HERE gives
-        backpressure: the core pauses intake only when the pipeline is full).
-        Deferred-call form (not a coroutine argument) so a task cancelled
-        before it first runs leaves no never-awaited coroutine behind."""
-        await self._verify_sem.acquire()
-        task = spawn(self._release_after(fn, *args), name="mempool-verify")
+    async def _spawn_verification(self, fn, *args, sem=None) -> None:
+        """Run `fn(*args)` in a background task, holding a slot of `sem`
+        (default: the workload pipeline cap `_verify_sem`; payload
+        acceptance passes the wider `_accept_sem`). Callers check
+        `sem.locked()` BEFORE calling (dropping or skipping instead), so
+        the acquire here never actually parks the core loop. Deferred-call
+        form (not a coroutine argument) so a task cancelled before it
+        first runs leaves no never-awaited coroutine behind."""
+        sem = self._verify_sem if sem is None else sem
+        await sem.acquire()
+        task = spawn(self._release_after(sem, fn, *args), name="mempool-verify")
         self._inflight.add(task)
         task.add_done_callback(self._inflight.discard)
 
-    async def _release_after(self, fn, *args) -> None:
+    async def _release_after(self, sem, fn, *args) -> None:
         try:
             await fn(*args)
         except Exception as e:  # must not kill the task group silently
             log.warning("background verification error: %r", e)
         finally:
-            self._verify_sem.release()
+            sem.release()
 
     # -- payload handling ----------------------------------------------------
 
@@ -208,7 +235,30 @@ class Core:
             payload.size() <= self.parameters.max_payload_size,
             PayloadTooBigError(payload.size(), self.parameters.max_payload_size),
         )
-        await self._spawn_verification(self._finish_others_payload, payload)
+        # Acceptance (verify the author's ONE signature, store, queue) is
+        # cheap and consensus-critical: it rides its own wide bound
+        # (_accept_sem), never the workload-saturated _verify_sem. Only
+        # the synthetic workload batch rides the capped pipeline (see
+        # _finish_others_payload): under saturation the measurement load
+        # is skipped, never the payload. Blocking the core loop here (the
+        # pre-round-5 design awaited a semaphore slot held by queued
+        # workload batches) starved PayloadRequest serving and froze
+        # commits after ~90 s in every 300 s saturation run; dropping at
+        # the acceptance bound keeps the loop responsive against a
+        # Byzantine payload flood, and the synchronizer re-fetches
+        # anything consensus actually needs.
+        if self._accept_sem.locked():
+            self._gossip_dropped += 1
+            if self._gossip_dropped % 1_000 == 1:
+                log.warning(
+                    "payload acceptance bound full: %s gossiped payloads "
+                    "dropped",
+                    self._gossip_dropped,
+                )
+            return
+        await self._spawn_verification(
+            self._finish_others_payload, payload, sem=self._accept_sem
+        )
 
     async def _finish_others_payload(self, payload: Payload) -> None:
         ok = await payload.verify_async(self.committee, self.verification_service)
@@ -221,14 +271,10 @@ class Core:
         # outcome is measured, not consumed).
         await self._store_payload(payload)
         self._queue_insert(payload.digest())
-        # Inline (not _submit_synthetic_batch): this coroutine already runs
-        # inside a bounded background task holding a _verify_sem slot.
-        n = len(payload.transactions)
-        if self.pool is not None and n > 0:
-            # NOTE: This log entry is used to compute performance.
-            log.info("Verifying OTHER transaction batch. Size: %s", n)
-            msgs, pairs = self.pool.take(n)
-            await self._run_synthetic(msgs, pairs)
+        # The synthetic OTHER batch rides the capped pipeline; at a full
+        # pipeline the measurement load is skipped so acceptance never
+        # queues behind it.
+        await self._submit_synthetic_batch("OTHER", len(payload.transactions))
 
     def _queue_insert(self, digest: Digest) -> None:
         if digest in self._cleaned:
@@ -248,8 +294,13 @@ class Core:
             raw = await self.store.read(PAYLOAD_PREFIX + digest.data)
             if raw is not None:
                 payload = Payload.decode(Reader(raw))
+                # Urgent: the requester's consensus is stalled on this
+                # payload; behind the gossip backlog it would drop and the
+                # requester would re-broadcast forever.
                 await self.network_tx.put(
-                    NetMessage(encode_mempool_message(payload), [addr])
+                    NetMessage(
+                        encode_mempool_message(payload), [addr], urgent=True
+                    )
                 )
 
     # -- consensus driver ----------------------------------------------------

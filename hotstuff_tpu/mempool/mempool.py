@@ -53,7 +53,7 @@ class Mempool:
             decode=decode_mempool_message,
             name="mempool-receiver",
         )
-        NetSender(network_tx, name="mempool-sender")
+        sender = NetSender(network_tx, name="mempool-sender")
 
         payload_maker = PayloadMaker(
             name,
@@ -85,9 +85,14 @@ class Mempool:
         )
         # Close the shedding loop: the payload maker stops flushing (and
         # starts dropping txs) while the core's payload queue is full —
-        # every flush past that point would fail _queue_insert anyway.
-        payload_maker.backlog_fn = (
-            lambda: len(core.queue) >= parameters.queue_capacity
+        # every flush past that point would fail _queue_insert anyway — OR
+        # while gossip egress is backlogged to a majority of peers: a
+        # payload produced then would drop on the wire, leaving a digest
+        # the committee can't fetch without sync round-trips (admission
+        # shedding at the Front is where overload is supposed to land).
+        payload_maker.backlog_fn = lambda: (
+            len(core.queue) >= parameters.queue_capacity
+            or sender.egress_backlogged()
         )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)

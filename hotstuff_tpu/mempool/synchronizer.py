@@ -96,7 +96,9 @@ class Synchronizer:
                 if a is not None
             ]
         if addrs:
-            await self.network_tx.put(NetMessage(data, addrs))
+            # Urgent: a sync request stuck behind the very gossip backlog
+            # that caused the miss would never un-stall consensus.
+            await self.network_tx.put(NetMessage(data, addrs, urgent=True))
 
     def cleanup(self, round_: int) -> None:
         """Cancel waiters for blocks at or below the committed round

@@ -12,9 +12,10 @@ Capability parity with the reference `network` crate (network/src/lib.rs):
 
 Wire format: 4-byte big-endian length prefix (tokio LengthDelimitedCodec
 default) followed by the codec payload. Properties the protocol relies on:
-per-peer FIFO (one ordered TCP stream + per-peer queue), at-most-once, NO
-delivery guarantee. This is the control plane and deliberately stays on
-host CPU/TCP; ICI collectives appear only inside the TPU crypto step.
+per-peer per-LANE FIFO (one ordered TCP stream; urgent recovery traffic
+may overtake bulk gossip — see NetSender), at-most-once, NO delivery
+guarantee. This is the control plane and deliberately stays on host
+CPU/TCP; ICI collectives appear only inside the TPU crypto step.
 """
 
 from __future__ import annotations
@@ -25,7 +26,7 @@ import struct
 from dataclasses import dataclass
 from typing import Callable
 
-from ..utils.actors import channel, spawn
+from ..utils.actors import Selector, channel, spawn
 
 log = logging.getLogger("hotstuff.network")
 
@@ -36,10 +37,15 @@ MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
 
 @dataclass(slots=True)
 class NetMessage:
-    """(serialized bytes, recipient addresses) -- network/src/lib.rs:27."""
+    """(serialized bytes, recipient addresses) -- network/src/lib.rs:27.
+
+    `urgent` selects the hot egress lane: protocol-critical recovery
+    traffic (payload sync requests/replies) that must not queue behind
+    bulk gossip. See NetSender."""
 
     data: bytes
     addresses: list[Address]
+    urgent: bool = False
 
 
 def frame(data: bytes) -> bytes:
@@ -102,40 +108,89 @@ class FrameReader:
 
 
 class NetSender:
-    """Receives NetMessage from a channel; maintains one worker (with its own
-    bounded queue) per peer address so a slow peer never blocks broadcast
-    (network/src/lib.rs:44-57,60-86)."""
+    """Receives NetMessage from a channel; maintains one worker per peer
+    address so a slow peer never blocks broadcast
+    (network/src/lib.rs:44-57,60-86).
+
+    Each peer has TWO bounded lanes: `urgent` messages (payload sync
+    requests/replies — the recovery path that un-stalls consensus) ride a
+    hot lane the worker drains first; everything else (bulk payload
+    gossip) rides the cold lane. A single FIFO let megabytes of gossip
+    backlog starve the kilobyte-scale recovery replies that would have
+    cleared it — the round-5 300 s saturation runs stalled exactly this
+    way (sync retries re-broadcast for minutes while replies sat behind
+    gossip and dropped). FIFO order is preserved WITHIN each lane; no
+    protocol message relies on cross-lane ordering (payload delivery and
+    sync replies are idempotent at the receiver)."""
 
     PEER_QUEUE = 1_000
 
     def __init__(self, rx: asyncio.Queue, name: str = "net-sender") -> None:
         self._rx = rx
         self._name = name
-        self._peers: dict[Address, asyncio.Queue] = {}
+        # addr -> (hot, cold) queues
+        self._peers: dict[Address, tuple[asyncio.Queue, asyncio.Queue]] = {}
         self._task = spawn(self._run(), name=name)
+
+    def egress_backlogged(self, frac: float = 0.5) -> bool:
+        """True while MORE THAN HALF of the peers' COLD lanes sit above
+        `frac` of capacity — i.e. gossip fan-out can no longer reach a
+        majority without dropping. Producers (the payload maker) use this
+        as a high-water backpressure signal: without it, sustained
+        overload fills the per-peer queues, payload gossip drops
+        silently, replicas stall on payload availability, and consensus
+        crawls at timeout pace while the producer keeps flooding.
+        Majority (not any) so one slow/Byzantine peer whose queue sits
+        full cannot throttle our payload production. The hot lane is
+        excluded: recovery traffic must never feed back into shedding."""
+        if not self._peers:
+            return False
+        high_water = int(self.PEER_QUEUE * frac)
+        over = sum(
+            1 for _, cold in self._peers.values() if cold.qsize() > high_water
+        )
+        return over * 2 > len(self._peers)
 
     async def _run(self) -> None:
         while True:
             msg: NetMessage = await self._rx.get()
             payload = frame(msg.data)
             for addr in msg.addresses:
-                q = self._peers.get(addr)
-                if q is None:
-                    q = asyncio.Queue(self.PEER_QUEUE)
-                    self._peers[addr] = q
-                    spawn(self._worker(addr, q), name=f"{self._name}-{addr}")
+                lanes = self._peers.get(addr)
+                if lanes is None:
+                    lanes = (
+                        asyncio.Queue(self.PEER_QUEUE),
+                        asyncio.Queue(self.PEER_QUEUE),
+                    )
+                    self._peers[addr] = lanes
+                    spawn(
+                        self._worker(addr, *lanes),
+                        name=f"{self._name}-{addr}",
+                    )
+                q = lanes[0] if msg.urgent else lanes[1]
                 try:
                     q.put_nowait(payload)
                 except asyncio.QueueFull:
                     # Fire-and-forget: drop rather than block the fan-out.
                     log.debug("dropping message to %s: peer queue full", addr)
 
-    async def _worker(self, addr: Address, q: asyncio.Queue) -> None:
-        """Per-peer worker: lazily connects, writes frames in FIFO order,
-        drops messages while the peer is unreachable."""
+    async def _worker(
+        self, addr: Address, hot: asyncio.Queue, cold: asyncio.Queue
+    ) -> None:
+        """Per-peer worker: lazily connects, writes frames in per-lane FIFO
+        order (hot lane first), drops messages while the peer is
+        unreachable. The Selector's anti-starvation bound means a saturated
+        hot lane still lets the occasional cold frame through rather than
+        parking gossip forever."""
+        # Selector serves LOWER priority numbers first (the pacemaker's
+        # must-lose timer branch gets priority=1 in consensus/core.py):
+        # hot keeps the winning default 0, cold must lose ties.
+        selector = Selector()
+        selector.add("hot", hot.get)
+        selector.add("cold", cold.get, priority=1)
         writer: asyncio.StreamWriter | None = None
         while True:
-            payload = await q.get()
+            _branch, payload = await selector.next()
             if writer is None:
                 try:
                     _, writer = await asyncio.open_connection(addr[0], addr[1])
