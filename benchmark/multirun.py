@@ -41,6 +41,8 @@ def main() -> None:
     p.add_argument("--runs", type=int, default=3)
     p.add_argument("--crypto", default="cpu", choices=["cpu", "tpu"])
     p.add_argument("--benchmark-workload", action="store_true")
+    p.add_argument("--mempool-payload-size", type=int, default=None,
+                   help="override mempool max_payload_size (bytes)")
     p.add_argument("--timeout-delay", type=int, default=None)
     p.add_argument("--outdir", default="data/local/multirun")
     p.add_argument("--tag", default="",
@@ -61,6 +63,8 @@ def main() -> None:
     node_params = {k: dict(v) for k, v in LOCAL_NODE_PARAMS.items()}
     if args.benchmark_workload:
         node_params["mempool"]["benchmark_mode"] = True
+    if args.mempool_payload_size is not None:
+        node_params["mempool"]["max_payload_size"] = args.mempool_payload_size
     if args.timeout_delay is not None:
         node_params["consensus"]["timeout_delay"] = args.timeout_delay
 
