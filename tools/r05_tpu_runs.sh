@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-5 in-system TPU measurement batch — run AFTER tools/tpu_session.sh
+# when the axon relay is up. Captures the VERDICT item-4 target run (300 s
+# TPU-workload sustained) and the TPU side of the saturation pair with
+# run counts >= 3.
+set -u
+cd "$(dirname "$0")/.."
+
+if ! python -c "from hotstuff_tpu.ops import check_axon_relay; check_axon_relay()"; then
+  echo "relay unreachable; aborting" >&2
+  exit 1
+fi
+
+echo "=== 300 s TPU-workload sustained run (VERDICT item 4 target)"
+python -m benchmark.run_local --nodes 4 --rate 3000 --size 512 \
+  --duration 300 --crypto tpu --benchmark-workload \
+  --mempool-payload-size 100000 --timeout-delay 2500 \
+  | tee data/local/bench-4-3000-512-0-tpu-workload-300s-r05.txt
+
+echo "=== TPU saturation pair, 120 s x3"
+python -m benchmark.multirun --nodes 4 --rate 3000 --size 512 \
+  --duration 120 --runs 3 --crypto tpu --benchmark-workload \
+  --mempool-payload-size 100000 --timeout-delay 2500 \
+  --outdir data/local/multirun_r05_tpuwl3k --tag tpu-workload
+echo "=== done"
