@@ -116,6 +116,13 @@ def _parse_node(text: str) -> dict:
     ):
         out["verif_batches"].append((_to_posix(ts), int(n)))
     out["timeouts"] = len(_search_all(r"Timeout reached", text))
+    # Cumulative count from the periodic saturation warning. The LAST
+    # logged milestone is a LOWER BOUND on the node's total shed (the node
+    # is killed by SIGTERM, so up to one 25k-milestone of tail sheds goes
+    # unlogged); 0 when never saturated.
+    shed = _search_all(r"(\d+) synthetic workload signatures skipped", text)
+    # single-group findall yields plain strings
+    out["workload_shed"] = int(shed[-1]) if shed else 0
     return out
 
 
@@ -171,6 +178,7 @@ class LogParser:
         self.sample_to_payload: dict[int, str] = {}
         self.verif_batches: list[tuple[float, int]] = []  # (t, batch size)
         self.timeouts = 0
+        self.workload_shed = 0
         self.configs = self._parse_configs(nodes[0] if nodes else "")
         for r in _map_logs(_parse_node, nodes):
             for digest, t in r["proposals"].items():
@@ -187,6 +195,7 @@ class LogParser:
             self.sample_to_payload.update(r["sample_to_payload"])
             self.verif_batches.extend(r["verif_batches"])
             self.timeouts += r["timeouts"]
+            self.workload_shed += r["workload_shed"]
 
     @staticmethod
     def _parse_configs(text: str) -> dict:
@@ -327,7 +336,12 @@ class LogParser:
             f" End-to-end BPS: {round(e_bps):,} B/s\n"
             f" End-to-end latency: {round(e_lat * 1000):,} ms\n"
             f" Batch verification rate: {round(v_rate):,} sigs/s ({v_total:,} total)\n"
-            "-----------------------------------------\n"
+            + (
+                f" Workload shed at saturation: >= {self.workload_shed:,} sigs\n"
+                if self.workload_shed
+                else ""
+            )
+            + "-----------------------------------------\n"
         )
 
     @classmethod

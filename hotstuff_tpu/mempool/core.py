@@ -160,7 +160,7 @@ class Core:
             # PayloadRequests — the recovery path consensus stalls on.
             before = self._synthetic_skipped
             self._synthetic_skipped += n
-            if before // 100_000 != self._synthetic_skipped // 100_000:
+            if before == 0 or before // 25_000 != self._synthetic_skipped // 25_000:
                 log.warning(
                     "verification pipeline saturated: %s synthetic workload "
                     "signatures skipped so far (measured rate reflects "

@@ -200,3 +200,22 @@ def test_log_parser_single_client_window_unchanged():
     assert p.steady_start == p.start
     tps, bps, _ = p.end_to_end_throughput()
     assert bps > 0
+
+
+def test_log_parser_reports_workload_shed():
+    """The periodic saturation warning's cumulative counter surfaces as a
+    'Workload shed' line; absent when never saturated."""
+    from benchmark.logs import LogParser
+
+    assert "Workload shed" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node = NODE_LOG + (
+        "[2026-07-30T10:00:03.000Z WARNING hotstuff.mempool] verification "
+        "pipeline saturated: 100195 synthetic workload signatures skipped "
+        "so far (measured rate reflects capacity, not demand)\n"
+        "[2026-07-30T10:00:04.000Z WARNING hotstuff.mempool] verification "
+        "pipeline saturated: 200390 synthetic workload signatures skipped "
+        "so far (measured rate reflects capacity, not demand)\n"
+    )
+    p = LogParser([CLIENT_LOG], [node])
+    assert p.workload_shed == 200390  # LAST cumulative value, not a sum
+    assert "Workload shed at saturation: >= 200,390 sigs" in p.result()
