@@ -95,6 +95,13 @@ class MempoolParameters:
     # triples are drawn cyclically from the pool).
     benchmark_mode: bool = False
     synthetic_pool_size: int = 10_000
+    # Byzantine bound on PayloadRequest serving: at most this many payloads
+    # are served per request frame (the prefix; the requester's retry loop
+    # fetches the rest). Honest requests cover one block's digests —
+    # consensus max_payload_size/32, 15 at the default config — so the
+    # default leaves ample headroom while capping the reply amplification
+    # a hostile requester can extract from one small frame.
+    max_request_digests: int = 1_024
 
     def log(self, log) -> None:
         # NOTE: these log entries are parsed by the benchmark harness.
@@ -111,6 +118,7 @@ class MempoolParameters:
             "min_block_delay": self.min_block_delay,
             "benchmark_mode": self.benchmark_mode,
             "synthetic_pool_size": self.synthetic_pool_size,
+            "max_request_digests": self.max_request_digests,
         }
 
     @staticmethod
@@ -123,6 +131,7 @@ class MempoolParameters:
             "min_block_delay",
             "benchmark_mode",
             "synthetic_pool_size",
+            "max_request_digests",
         ):
             if k in obj:
                 setattr(p, k, obj[k])

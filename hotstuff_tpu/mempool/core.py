@@ -120,6 +120,7 @@ class Core:
         self._inflight: set[asyncio.Task] = set()
         self._gossip_dropped = 0  # payloads shed at full acceptance bound
         self._synthetic_skipped = 0  # workload sigs skipped at a full pipeline
+        self._requests_clamped = 0  # oversized payload requests clamped
         # Undelivered payload digests, insertion-ordered (core.rs:50 queue).
         self.queue: dict[Digest, None] = {}
         # Digests already consumed by consensus cleanup. Background payload
@@ -286,11 +287,32 @@ class Core:
         self.queue[digest] = None
 
     async def _handle_request(self, request: PayloadRequest) -> None:
-        """Serve stored payloads to a lagging peer (core.rs:236-249)."""
+        """Serve stored payloads to a lagging peer (core.rs:236-249).
+
+        Byzantine bound: replies ride the URGENT egress lane (they un-stall
+        the requester's consensus), which a hostile requester could exploit
+        as a priority-amplified reflector — at most
+        `parameters.max_request_digests` payloads are served per request
+        (the PREFIX, so an honest requester with an unusually large block
+        still makes progress via its retry loop), and unknown requesters
+        are ignored."""
+        digests = request.digests
+        cap = self.parameters.max_request_digests
+        if len(digests) > cap:
+            self._requests_clamped += 1
+            if self._requests_clamped % 1_000 == 1:
+                log.warning(
+                    "clamping oversized payload request (%s digests) from "
+                    "%s (%s clamped so far)",
+                    len(digests),
+                    request.requester.short(),
+                    self._requests_clamped,
+                )
+            digests = digests[:cap]
         addr = self.committee.mempool_address(request.requester)
         if addr is None:
             return
-        for digest in request.digests:
+        for digest in digests:
             raw = await self.store.read(PAYLOAD_PREFIX + digest.data)
             if raw is not None:
                 payload = Payload.decode(Reader(raw))

@@ -307,3 +307,70 @@ def test_others_payload_runs_synthetic_workload(run_async, base_port, caplog):
 
     with caplog.at_level(logging.INFO, logger="hotstuff.mempool"):
         run_async(body())
+
+
+def test_oversized_payload_request_clamped(run_async, base_port):
+    """A Byzantine PayloadRequest naming more digests than the configured
+    cap is served only up to the cap (prefix) — the replies ride the
+    urgent egress lane, so unbounded requests would be a
+    priority-amplified reflector. An honest requester with a large block
+    still makes progress (prefix served, retry fetches the rest)."""
+
+    async def body():
+        from hotstuff_tpu.mempool.core import PAYLOAD_PREFIX, Core
+        from hotstuff_tpu.mempool.messages import (
+            PayloadRequest,
+            encode_mempool_message,
+            decode_mempool_message,
+        )
+        from hotstuff_tpu.utils.serde import Writer
+
+        n = 4
+        cmt = mempool_committee(base_port, n)
+        params = MempoolParameters(
+            max_payload_size=64, min_block_delay=10, max_request_digests=2
+        )
+        (pk0, sk0), (pk1, sk1) = keys(n)[:2]
+        store = Store()
+        network_tx = channel()
+        core = Core(
+            pk0, cmt, params, store, None, None, channel(), channel(), network_tx
+        )
+
+        from hotstuff_tpu.crypto import Signature
+
+        # Store three real payloads so serving is observable.
+        payloads = [
+            Payload((bytes([i]) * 8,), pk1, Signature.new(Digest.of(b"x"), sk1))
+            for i in range(3)
+        ]
+        for p in payloads:
+            w = Writer()
+            p.encode(w)
+            await store.write(PAYLOAD_PREFIX + p.digest().data, w.bytes())
+
+        req = decode_mempool_message(
+            encode_mempool_message(
+                PayloadRequest(tuple(p.digest() for p in payloads), pk1)
+            )
+        )
+        await core._handle_request(req)
+        # Only the 2-digest prefix was served; the clamp was counted.
+        assert core._requests_clamped == 1
+        served = []
+        while not network_tx.empty():
+            served.append(network_tx.get_nowait())
+        assert len(served) == 2, f"expected clamped prefix, got {len(served)}"
+        assert all(m.urgent for m in served)
+
+        # An at-cap request is NOT clamped (boundary: '>' not '>=').
+        req_ok = PayloadRequest(tuple(p.digest() for p in payloads[:2]), pk1)
+        await core._handle_request(req_ok)
+        assert core._requests_clamped == 1
+        count = 0
+        while not network_tx.empty():
+            network_tx.get_nowait()
+            count += 1
+        assert count == 2
+
+    run_async(body())
