@@ -3,7 +3,11 @@
 # Run when the axon relay (127.0.0.1:8082) is reachable; captures every
 # microbenchmark + the driver benchmarks into data/device/.
 #
-#   bash tools/tpu_session.sh
+#   bash tools/tpu_session.sh          # full session
+#   bash tools/tpu_session.sh --quick  # decision-critical subset only
+#                                      # (chunk sweep, device-hash A/B,
+#                                      # headline bench, committee scale)
+#                                      # for short relay windows
 #
 # Keep the host otherwise IDLE (1 vCPU: concurrent work corrupts timings).
 #
@@ -110,16 +114,23 @@ run() {
   fi
 }
 
-run tune_vpu    python tools/tune_device.py --vpu
-run tune_field  python tools/tune_device.py --field
-run tune_phases python tools/tune_device.py --phases
-run tune_chunks python tools/tune_device.py --chunks
-run tune_dh     python tools/tune_device.py --dh
-run latch_probe python tools/latch_probe.py
-run profile_e2e python tools/profile_e2e.py
-run bench       python bench.py
-run bench_mesh  python bench.py --mesh
-run committee   python bench.py --committee-scale
+if [ "${1:-}" = "--quick" ]; then
+  run tune_chunks python tools/tune_device.py --chunks
+  run tune_dh     python tools/tune_device.py --dh
+  run bench       python bench.py
+  run committee   python bench.py --committee-scale
+else
+  run tune_vpu    python tools/tune_device.py --vpu
+  run tune_field  python tools/tune_device.py --field
+  run tune_phases python tools/tune_device.py --phases
+  run tune_chunks python tools/tune_device.py --chunks
+  run tune_dh     python tools/tune_device.py --dh
+  run latch_probe python tools/latch_probe.py
+  run profile_e2e python tools/profile_e2e.py
+  run bench       python bench.py
+  run bench_mesh  python bench.py --mesh
+  run committee   python bench.py --committee-scale
+fi
 trap - EXIT INT TERM
 if [ "$ok_count" -eq 0 ]; then
   echo "session FAILED: no benchmark succeeded; keeping logs in failed_session_$stamp" >&2
