@@ -345,9 +345,21 @@ def main(argv: list[str] | None = None) -> None:
     )
     p.add_argument("--max-delay", type=float, default=0.002)
     p.add_argument(
+        "--chunk",
+        type=int,
+        default=None,
+        help="upload-pipeline chunk size (clamped to the bucket cap); the "
+        "device chunk sweep (tools/tune_device.py --chunks) decides this",
+    )
+    p.add_argument(
         "--no-warmup", action="store_true", help="skip bucket pre-compilation"
     )
     args = p.parse_args(argv)
+    if args.chunk is not None and args.chunk <= 0:
+        # 0 would silently fall back to the default chunk downstream and a
+        # negative value breaks the upload loop — neither may record a
+        # sweep under a config the operator didn't specify.
+        p.error("--chunk must be positive")
     setup_logging(args.verbose)
     if args.backend == "tpu":
         from ..ops import enable_persistent_cache
@@ -358,10 +370,15 @@ def main(argv: list[str] | None = None) -> None:
 
             mesh = init_multihost()
             backend = make_backend(
-                args.backend, mesh=mesh, min_bucket=args.min_bucket
+                args.backend,
+                mesh=mesh,
+                min_bucket=args.min_bucket,
+                chunk=args.chunk,
             )
         else:
-            backend = make_backend(args.backend, min_bucket=args.min_bucket)
+            backend = make_backend(
+                args.backend, min_bucket=args.min_bucket, chunk=args.chunk
+            )
     else:
         # A sweep that silently ignored these flags would record numbers
         # under a different config than the operator specified.
@@ -369,6 +386,8 @@ def main(argv: list[str] | None = None) -> None:
             p.error("--multihost requires --backend tpu")
         if args.min_bucket != p.get_default("min_bucket"):
             p.error("--min-bucket requires --backend tpu")
+        if args.chunk is not None:
+            p.error("--chunk requires --backend tpu")
         backend = make_backend(args.backend)
     from ..utils.logging import quiet_jax_logs
 

@@ -30,6 +30,7 @@ class TpuBackend(CryptoBackend):
         min_bucket: int = 128,
         mesh=None,
         sharded: bool = False,
+        chunk: int | None = None,
     ):
         # import lazily so CPU-only processes never touch jax
         from ..ops import enable_persistent_cache
@@ -46,6 +47,7 @@ class TpuBackend(CryptoBackend):
                 min_bucket=min_bucket,
                 max_bucket=max_bucket,
                 kernel=kernel,
+                chunk=chunk,
             )
         else:
             import jax
@@ -57,7 +59,10 @@ class TpuBackend(CryptoBackend):
             # format + threaded upload pipeline either way.
             kernel = "w4" if jax.default_backend() == "cpu" else "pallas"
             self._verifier = Ed25519TpuVerifier(
-                min_bucket=min_bucket, max_bucket=max_bucket, kernel=kernel
+                min_bucket=min_bucket,
+                max_bucket=max_bucket,
+                kernel=kernel,
+                chunk=chunk,
             )
         self._cpu = CpuBackend()
         self.crossover = crossover
