@@ -39,13 +39,19 @@ class CommandMaker:
         )
 
     @staticmethod
-    def run_sidecar(port: int, backend: str = "tpu", debug: bool = False) -> str:
+    def run_sidecar(
+        port: int,
+        backend: str = "tpu",
+        debug: bool = False,
+        chunk: int | None = None,
+    ) -> str:
         """The shared crypto sidecar: one process owns the TPU; all local
         nodes ship their large verification batches to it."""
         v = "-vvv" if debug else "-vv"
+        chunk_arg = f" --chunk {chunk}" if chunk is not None else ""
         return (
             f"{sys.executable} -m hotstuff_tpu.crypto.remote {v} "
-            f"--port {port} --backend {backend}"
+            f"--port {port} --backend {backend}{chunk_arg}"
         )
 
     @staticmethod

@@ -31,6 +31,9 @@ class LocalBench:
         self.bench = BenchParameters(bench_params)
         self.node_params = NodeParameters(node_params)
         self.crypto = bench_params.get("crypto", "cpu")
+        # Sidecar pipeline chunk override (device chunk sweep's verdict);
+        # None = verifier default.
+        self.sidecar_chunk = bench_params.get("sidecar_chunk")
         self._procs: list[subprocess.Popen] = []
 
     def _background_run(self, command: str, log_file: str) -> subprocess.Popen:
@@ -113,7 +116,12 @@ class LocalBench:
                 sidecar_port = self.BASE_PORT - 100
                 crypto_addr = f"127.0.0.1:{sidecar_port}"
                 sidecar_proc = self._background_run(
-                    CommandMaker.run_sidecar(sidecar_port, "tpu", debug=debug),
+                    CommandMaker.run_sidecar(
+                        sidecar_port,
+                        "tpu",
+                        debug=debug,
+                        chunk=self.sidecar_chunk,
+                    ),
                     join("logs", "sidecar.log"),
                 )
                 # JAX/TPU init + per-bucket warmup (even cache-hits pay

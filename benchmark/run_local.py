@@ -28,8 +28,17 @@ def main() -> None:
                    "config uses 500 kB, fabfile.py:107-120)")
     p.add_argument("--timeout-delay", type=int, default=None,
                    help="override consensus timeout_delay (ms)")
+    p.add_argument("--sidecar-chunk", type=int, default=None,
+                   help="TPU sidecar upload-pipeline chunk size (the device "
+                   "chunk sweep's verdict); only with --crypto tpu")
     p.add_argument("--debug", action="store_true")
     args = p.parse_args()
+    if args.sidecar_chunk is not None and args.crypto != "tpu":
+        p.error("--sidecar-chunk requires --crypto tpu")
+    if args.sidecar_chunk is not None and args.sidecar_chunk <= 0:
+        # mirror the sidecar CLI's own check; failing here beats a
+        # mid-benchmark "sidecar exited at startup"
+        p.error("--sidecar-chunk must be positive")
 
     bench_params = {
         "nodes": args.nodes,
@@ -38,6 +47,7 @@ def main() -> None:
         "faults": args.faults,
         "duration": args.duration,
         "crypto": args.crypto,
+        "sidecar_chunk": args.sidecar_chunk,
     }
     node_params = {k: dict(v) for k, v in LOCAL_NODE_PARAMS.items()}
     if args.benchmark_workload:
