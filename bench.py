@@ -187,6 +187,74 @@ def bench_committee_scale(
     )
 
 
+def _write_metrics(path: str, note: str | None = None) -> None:
+    """Commit the structured metrics artifact next to the bench JSON. The
+    registry pre-registers the full canonical namespace (utils/metrics.py),
+    so the dump always contains the verifier stage histograms and consensus
+    counters — zeros for layers this process never exercised. `note` marks
+    degraded artifacts (cpu-fallback, junk-only error runs) so a
+    before/after diff can't mistake them for real measurements."""
+    from hotstuff_tpu.utils import metrics
+
+    d = metrics.dump()
+    if note:
+        d["note"] = note
+    with open(path, "w") as f:
+        json.dump(d, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _write_metrics_safe(path: str | None, note: str | None) -> None:
+    if not path:
+        return
+    try:
+        _write_metrics(path, note)
+    except OSError as e:
+        print(f"# failed to write metrics: {e}", file=sys.stderr)
+
+
+def _degraded_note(payload: dict) -> str | None:
+    note = payload.get("error") or (
+        "cpu-fallback" if payload.get("backend") == "cpu-fallback" else None
+    )
+    if payload.get("backend") == "error":
+        note = f"degraded run, no real measurements: {note}"
+    return note
+
+
+def _emit(payload: dict, metrics_out: str | None) -> None:
+    _write_metrics_safe(metrics_out, _degraded_note(payload))
+    print(json.dumps(payload))
+
+
+def _downscale_for_cpu(args) -> None:
+    """Clamp the workload to what the CPU interpreter can verify in seconds
+    (the pallas ladder has no CPU lowering; the w4 jnp kernel does)."""
+    if args.kernel == "pallas":
+        args.kernel = "w4"
+    args.batch = min(args.batch, 512)
+    args.device_batch = min(args.device_batch, 128)
+    args.chunk = min(args.chunk, 128)
+    args.iters = min(args.iters, 2)
+    args.e2e_iters = min(args.e2e_iters, 1)
+    args.cpu_budget = min(args.cpu_budget, 0.5)
+
+
+def _record_junk_verification(kernel: str) -> None:
+    """Best-effort: run one junk batch through the verifier so the metrics
+    artifact carries real stage spans even when the host cannot generate
+    signed batches (e.g. no `cryptography` module). Masks are discarded —
+    junk never verifies; the spans and counters are the point."""
+    import os as _os
+
+    from hotstuff_tpu.ops.ed25519 import Ed25519TpuVerifier
+
+    v = Ed25519TpuVerifier(max_bucket=128, kernel=kernel, chunk=128)
+    v.verify_batch_mask(
+        [_os.urandom(32)] * 128, [_os.urandom(32)] * 128, [_os.urandom(64)] * 128
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16384)
@@ -196,6 +264,12 @@ def main() -> None:
     ap.add_argument("--e2e-iters", type=int, default=3)
     ap.add_argument("--cpu-budget", type=float, default=3.0)
     ap.add_argument("--kernel", default="pallas", choices=["w4", "bits", "pallas"])
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="write the structured metrics dump (utils/metrics.py) here — "
+        "the committed artifact next to each BENCH_rN.json",
+    )
     ap.add_argument(
         "--committee-scale",
         action="store_true",
@@ -215,39 +289,98 @@ def main() -> None:
 
     from hotstuff_tpu.ops import check_axon_relay, enable_persistent_cache
 
+    relay_error = None
     try:
         check_axon_relay()
     except RuntimeError as e:
-        sys.exit(str(e))
+        # Degrade instead of rc=1 with an unparseable tail: fall back to
+        # the CPU interpreter so the driver's BENCH_rN.json always parses.
+        relay_error = str(e)
+        print(f"# {relay_error}; falling back to CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if relay_error is not None:
+        # The axon import hook force-sets JAX_PLATFORMS during `import jax`;
+        # override the config AFTER import (the tests/conftest.py dance).
+        jax.config.update("jax_platforms", "cpu")
 
     enable_persistent_cache()
+    cpu_fallback = jax.default_backend() == "cpu"
+    if cpu_fallback:
+        _downscale_for_cpu(args)
 
     if args.committee_scale:
-        bench_committee_scale(
-            args.kernel, args.chunk, args.cpu_budget, args.batch, args.e2e_iters
+        try:
+            bench_committee_scale(
+                args.kernel, args.chunk, args.cpu_budget, args.batch,
+                args.e2e_iters,
+            )
+        except Exception as e:
+            print(f"# bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+            _emit(
+                {
+                    "metric": "votes_verified_per_sec",
+                    "value": 0.0,
+                    "unit": "sigs/s",
+                    "vs_baseline": 0.0,
+                    "backend": "error",
+                    "error": f"{type(e).__name__}: {e}",
+                },
+                args.metrics_out,
+            )
+            return
+        note = "cpu-fallback" if cpu_fallback else None
+        if relay_error is not None:
+            note = f"{note}: {relay_error}"
+        _write_metrics_safe(args.metrics_out, note)
+        return
+
+    try:
+        from __graft_entry__ import _signed_batch
+
+        msgs, pks, sigs = _signed_batch(args.batch)
+        dn = min(args.device_batch, args.batch)
+
+        cpu_rate = bench_cpu(msgs[:dn], pks[:dn], sigs[:dn], args.cpu_budget)
+        cpu_multi = bench_cpu_multicore(msgs[:dn], pks[:dn], sigs[:dn])
+        print(
+            f"# cpu ed25519 baseline: {cpu_rate:,.0f} sigs/s single-thread, "
+            f"{cpu_multi:,.0f} sigs/s all {os.cpu_count()} threads",
+            file=sys.stderr,
+        )
+
+        device_rate = bench_device(
+            msgs[:dn], pks[:dn], sigs[:dn], args.iters, args.kernel
+        )
+        e2e_rate = bench_e2e(
+            msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters,
+            mesh=args.mesh,
+        )
+    except Exception as e:
+        # An unusable measurement environment (e.g. missing host crypto
+        # deps) must still produce a parseable JSON line and rc 0. Populate
+        # the verifier stage histograms with one junk batch so the metrics
+        # artifact shows the pipeline ran.
+        try:
+            _record_junk_verification(args.kernel)
+        except Exception:
+            pass
+        print(f"# bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        _emit(
+            {
+                "metric": "votes_verified_per_sec",
+                "value": 0.0,
+                "unit": "sigs/s",
+                "vs_baseline": 0.0,
+                "backend": "error",
+                "error": f"{type(e).__name__}: {e}",
+            },
+            args.metrics_out,
         )
         return
 
-    from __graft_entry__ import _signed_batch
-
-    msgs, pks, sigs = _signed_batch(args.batch)
-    dn = min(args.device_batch, args.batch)
-
-    cpu_rate = bench_cpu(msgs[:dn], pks[:dn], sigs[:dn], args.cpu_budget)
-    cpu_multi = bench_cpu_multicore(msgs[:dn], pks[:dn], sigs[:dn])
-    print(
-        f"# cpu ed25519 baseline: {cpu_rate:,.0f} sigs/s single-thread, "
-        f"{cpu_multi:,.0f} sigs/s all {os.cpu_count()} threads",
-        file=sys.stderr,
-    )
-
-    device_rate = bench_device(
-        msgs[:dn], pks[:dn], sigs[:dn], args.iters, args.kernel
-    )
-    e2e_rate = bench_e2e(
-        msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters,
-        mesh=args.mesh,
-    )
     print(
         f"# tpu kernel: {device_rate:,.0f} sigs/s device (batch={dn}), "
         f"{e2e_rate:,.0f} sigs/s end-to-end "
@@ -256,19 +389,19 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": "votes_verified_per_sec",
-                "value": round(device_rate, 1),
-                "unit": "sigs/s",
-                "vs_baseline": round(device_rate / cpu_rate, 3),
-                "e2e_value": round(e2e_rate, 1),
-                "e2e_vs_baseline": round(e2e_rate / cpu_rate, 3),
-                "cpu_multicore": round(cpu_multi, 1),
-            }
-        )
-    )
+    out = {
+        "metric": "votes_verified_per_sec",
+        "value": round(device_rate, 1),
+        "unit": "sigs/s",
+        "vs_baseline": round(device_rate / cpu_rate, 3),
+        "e2e_value": round(e2e_rate, 1),
+        "e2e_vs_baseline": round(e2e_rate / cpu_rate, 3),
+        "cpu_multicore": round(cpu_multi, 1),
+        "backend": "cpu-fallback" if cpu_fallback else jax.default_backend(),
+    }
+    if relay_error is not None:
+        out["error"] = relay_error
+    _emit(out, args.metrics_out)
 
 
 if __name__ == "__main__":

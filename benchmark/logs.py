@@ -17,6 +17,7 @@ volumes the regex pass is minutes of single-core work.
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from datetime import datetime, timezone
@@ -123,6 +124,19 @@ def _parse_node(text: str) -> dict:
     shed = _search_all(r"(\d+) synthetic workload signatures skipped", text)
     # single-group findall yields plain strings
     out["workload_shed"] = int(shed[-1]) if shed else 0
+    # METRICS snapshot lines (utils/metrics.py periodic emitter). Counters
+    # are cumulative, so only the LAST well-formed snapshot per node
+    # matters; a malformed blob (truncated by SIGTERM mid-line) is skipped,
+    # never a ParseError — observability must not fail the run.
+    out["metrics"] = None
+    for blob in reversed(_search_all(r"METRICS (\{.*\})\s*$", text)):
+        try:
+            snap = json.loads(blob)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(snap, dict):
+            out["metrics"] = snap
+            break
     return out
 
 
@@ -179,6 +193,9 @@ class LogParser:
         self.verif_batches: list[tuple[float, int]] = []  # (t, batch size)
         self.timeouts = 0
         self.workload_shed = 0
+        # Final METRICS snapshot per node (utils/metrics.py), and the
+        # cross-node aggregate (counters summed, histogram count/sum summed).
+        self.node_metrics: list[dict] = []
         self.configs = self._parse_configs(nodes[0] if nodes else "")
         for r in _map_logs(_parse_node, nodes):
             for digest, t in r["proposals"].items():
@@ -196,6 +213,35 @@ class LogParser:
             self.verif_batches.extend(r["verif_batches"])
             self.timeouts += r["timeouts"]
             self.workload_shed += r["workload_shed"]
+            if r.get("metrics") is not None:
+                self.node_metrics.append(r["metrics"])
+        self.metrics = self._merge_metrics(self.node_metrics)
+
+    @staticmethod
+    def _merge_metrics(snapshots: list[dict]) -> dict:
+        """Aggregate per-node snapshots: counters sum; histograms keep the
+        summed count/sum (mean re-derived) and the max of max — percentiles
+        are not mergeable across nodes and are dropped. Snapshots missing
+        keys or carrying junk values are tolerated (scraped from logs)."""
+        counters: dict[str, int] = {}
+        histograms: dict[str, dict] = {}
+        for snap in snapshots:
+            for name, v in (snap.get("counters") or {}).items():
+                if isinstance(v, (int, float)):
+                    counters[name] = counters.get(name, 0) + v
+            for name, h in (snap.get("histograms") or {}).items():
+                if not isinstance(h, dict):
+                    continue
+                agg = histograms.setdefault(
+                    name, {"count": 0, "sum": 0.0, "max": 0.0}
+                )
+                if isinstance(h.get("count"), (int, float)):
+                    agg["count"] += h["count"]
+                if isinstance(h.get("sum"), (int, float)):
+                    agg["sum"] += h["sum"]
+                if isinstance(h.get("max"), (int, float)):
+                    agg["max"] = max(agg["max"], h["max"])
+        return {"counters": counters, "histograms": histograms}
 
     @staticmethod
     def _parse_configs(text: str) -> dict:
@@ -312,6 +358,26 @@ class LogParser:
         e_tps, e_bps, _ = self.end_to_end_throughput()
         e_lat = self.end_to_end_latency()
         v_rate, v_total = self.verification_throughput()
+        mtr = ""
+        if self.metrics["counters"] or self.metrics["histograms"]:
+            lines = [
+                f" {name}: {value:,}"
+                for name, value in sorted(self.metrics["counters"].items())
+                if value
+            ]
+            for name, h in sorted(self.metrics["histograms"].items()):
+                if h["count"]:
+                    mean = h["sum"] / h["count"]
+                    lines.append(
+                        f" {name}: count={h['count']:,} mean={mean:.6g} "
+                        f"max={h['max']:.6g}"
+                    )
+            if lines:
+                mtr = (
+                    f" + METRICS ({len(self.node_metrics)} node snapshots):\n"
+                    + "\n".join(lines)
+                    + "\n"
+                )
         warn = ""
         if self.misses:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"
@@ -341,6 +407,7 @@ class LogParser:
                 if self.workload_shed
                 else ""
             )
+            + mtr
             + "-----------------------------------------\n"
         )
 

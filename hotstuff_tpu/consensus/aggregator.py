@@ -11,10 +11,20 @@ backend exploits: a full QC's signatures are verified as one vmapped batch.
 
 from __future__ import annotations
 
+import time
+
 from ..crypto import Digest, PublicKey, Signature
+from ..utils import metrics
 from .config import Committee
 from .errors import UnknownAuthorityError, ensure
 from .messages import QC, TC, Round, Timeout, Vote
+
+# qc_form_s / tc_form_s: first vote (or timeout) appended -> quorum fired —
+# the vote->QC leg of the proposal->vote->QC->commit latency chain.
+_M_QCS = metrics.counter("consensus.qcs")
+_M_TCS = metrics.counter("consensus.tcs")
+_M_QC_FORM = metrics.histogram("consensus.qc_form_s")
+_M_TC_FORM = metrics.histogram("consensus.tc_form_s")
 
 
 class QCMaker:
@@ -24,17 +34,22 @@ class QCMaker:
         self.weight = 0
         self.votes: list[tuple[PublicKey, Signature]] = []
         self.used: set[PublicKey] = set()
+        self._first_at: float | None = None
 
     def append(self, vote: Vote, committee: Committee) -> QC | None:
         if vote.author in self.used:
             return None  # redelivery (retries rebroadcast); not Byzantine
         stake = committee.stake(vote.author)
         ensure(stake > 0, UnknownAuthorityError(vote.author))
+        if self._first_at is None:
+            self._first_at = time.perf_counter()
         self.used.add(vote.author)
         self.votes.append((vote.author, vote.signature))
         self.weight += stake
         if self.weight >= committee.quorum_threshold():
             self.weight = 0  # fire exactly once (aggregator.rs:88)
+            _M_QCS.inc()
+            _M_QC_FORM.record(time.perf_counter() - self._first_at)
             return QC(vote.hash, vote.round, tuple(self.votes))
         return None
 
@@ -46,17 +61,22 @@ class TCMaker:
         self.weight = 0
         self.votes: list[tuple[PublicKey, Signature, Round]] = []
         self.used: set[PublicKey] = set()
+        self._first_at: float | None = None
 
     def append(self, timeout: Timeout, committee: Committee) -> TC | None:
         if timeout.author in self.used:
             return None  # redelivery (nodes re-timeout the same round)
         stake = committee.stake(timeout.author)
         ensure(stake > 0, UnknownAuthorityError(timeout.author))
+        if self._first_at is None:
+            self._first_at = time.perf_counter()
         self.used.add(timeout.author)
         self.votes.append((timeout.author, timeout.signature, timeout.high_qc.round))
         self.weight += stake
         if self.weight >= committee.quorum_threshold():
             self.weight = 0
+            _M_TCS.inc()
+            _M_TC_FORM.record(time.perf_counter() - self._first_at)
             return TC(timeout.round, tuple(self.votes))
         return None
 

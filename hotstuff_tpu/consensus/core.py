@@ -24,10 +24,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from ..crypto import Digest, PublicKey, SignatureService
 from ..network.net import NetMessage
 from ..store import Store
+from ..utils import metrics
 from ..utils.actors import Selector, Timer, spawn
 from ..utils.serde import Reader, Writer
 from .aggregator import Aggregator
@@ -51,6 +53,20 @@ from .synchronizer import Synchronizer
 log = logging.getLogger("hotstuff.consensus")
 
 _SAFETY_KEY = b"safety-state"
+
+# Stage tracing for the protocol state machine (COMPONENTS.md metric table).
+_M_PROPOSALS = metrics.counter("consensus.proposals")
+_M_VOTES = metrics.counter("consensus.votes")
+_M_COMMITS = metrics.counter("consensus.commits")
+_M_TIMEOUTS = metrics.counter("consensus.timeouts")
+_M_SYNC_SERVED = metrics.counter("consensus.sync_requests_served")
+_M_ROUND = metrics.gauge("consensus.round")
+_M_PROPOSAL_TO_VOTE = metrics.histogram("consensus.proposal_to_vote_s")
+_M_COMMIT_LATENCY = metrics.histogram("consensus.commit_latency_s")
+
+# Cap on the first-seen timestamp map feeding commit_latency_s: Byzantine
+# proposals that never commit must not grow it without bound.
+_SEEN_CAP = 4096
 
 
 class Core:
@@ -98,6 +114,9 @@ class Core:
         # Pacemaker backoff state: consecutive local timeouts without an
         # intervening QC-driven round advance (see Parameters.timeout_backoff).
         self._consecutive_timeouts = 0
+        # block digest -> first-seen monotonic time, for commit_latency_s
+        # (insertion-ordered; bounded by _SEEN_CAP, oldest evicted).
+        self._block_seen: dict[Digest, float] = {}
 
     # -- persistence of safety-critical state (fixes reference issue #15) ----
 
@@ -189,8 +208,13 @@ class Core:
                 break
             to_commit.append(parent)
         self.last_committed_round = block.round
+        now = time.perf_counter()
         for b in reversed(to_commit):
             d = b.digest()
+            _M_COMMITS.inc()
+            seen = self._block_seen.pop(d, None)
+            if seen is not None:
+                _M_COMMIT_LATENCY.record(now - seen)
             # NOTE: These log entries are used to compute performance.
             log.info("Committed B%s(%s)", b.round, d)
             for payload_digest in b.payload:
@@ -216,6 +240,7 @@ class Core:
         if round_ < self.round:
             return
         self.round = round_ + 1
+        _M_ROUND.set(self.round)
         log.debug("Moved to round %s", self.round)
         if self.timer is not None:
             self.timer.reset()
@@ -226,6 +251,7 @@ class Core:
 
     async def _local_timeout_round(self) -> None:
         """Pacemaker fired (core.rs:175-197)."""
+        _M_TIMEOUTS.inc()
         log.warning("Timeout reached for round %s", self.round)
         self.last_voted_round = max(self.last_voted_round, self.round)
         await self._store_safety_state()
@@ -268,6 +294,7 @@ class Core:
         block = Block(
             self.high_qc, tc, self.name, self.round, tuple(payload), signature
         )
+        _M_PROPOSALS.inc()
         if block.payload:
             # NOTE: This log entry is used to compute performance.
             log.info("Created B%s(%s)", block.round, block.digest())
@@ -278,12 +305,16 @@ class Core:
 
     async def _process_block(self, block: Block) -> None:
         """Ordering + commit logic (core.rs:327-378)."""
+        t0 = time.perf_counter()
         ancestors = await self.synchronizer.get_ancestors(block)
         if ancestors is None:
             log.debug("processing of %s suspended: missing ancestors", block)
             return
         b0, b1 = ancestors
         await self._store_block(block)
+        self._block_seen.setdefault(block.digest(), t0)
+        while len(self._block_seen) > _SEEN_CAP:
+            self._block_seen.pop(next(iter(self._block_seen)))
 
         # 2-chain commit rule.
         if b0.round + 1 == b1.round:
@@ -300,6 +331,8 @@ class Core:
         vote = await self._make_vote(block)
         if vote is None:
             return
+        _M_VOTES.inc()
+        _M_PROPOSAL_TO_VOTE.record(time.perf_counter() - t0)
         log.debug("created %s", vote)
         next_leader = self.leader_elector.get_leader(self.round + 1)
         if next_leader == self.name:
@@ -361,6 +394,7 @@ class Core:
         raw = await self.store.read(request.digest.data)
         if raw is None:
             return
+        _M_SYNC_SERVED.inc()
         block = Block.decode(Reader(raw))
         await self._transmit(block, request.requester)
 

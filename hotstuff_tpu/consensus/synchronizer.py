@@ -17,6 +17,7 @@ import time
 from ..crypto import Digest, PublicKey
 from ..network.net import NetMessage
 from ..store import Store
+from ..utils import metrics
 from ..utils.actors import spawn
 from .config import Committee
 from .messages import (
@@ -29,6 +30,9 @@ from .messages import (
 log = logging.getLogger("hotstuff.consensus")
 
 TIMER_ACCURACY_MS = 5_000  # reference synchronizer.rs TIMER_ACCURACY
+
+_M_SYNC_REQUESTS = metrics.counter("consensus.sync_requests")
+_M_SYNC_RETRIES = metrics.counter("consensus.sync_retries")
 
 
 class Synchronizer:
@@ -96,6 +100,7 @@ class Synchronizer:
         await self.core_channel.put(LoopBack(blocked))
 
     async def _request(self, digest: Digest) -> None:
+        _M_SYNC_REQUESTS.inc()
         data = encode_consensus_message(SyncRequest(digest, self.name))
         addrs = self.committee.broadcast_addresses(self.name)
         await self.network_tx.put(NetMessage(data, addrs))
@@ -107,4 +112,5 @@ class Synchronizer:
             for digest, ts in list(self._pending.items()):
                 if (now - ts) * 1000.0 >= self.sync_retry_delay:
                     log.debug("retrying sync request for %s", digest.short())
+                    _M_SYNC_RETRIES.inc()
                     await self._request(digest)

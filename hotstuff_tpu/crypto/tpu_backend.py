@@ -16,8 +16,17 @@ from __future__ import annotations
 import threading
 from typing import Sequence
 
+from ..utils import metrics
 from .backend import CpuBackend, CryptoBackend
 from .primitives import PublicKey, Signature
+
+# Mirrors the instance-local `stats` dict into the process-global metrics
+# registry so backend routing shows up in METRICS snapshots and dumps.
+_M_TPU_BATCHES = metrics.counter("crypto.tpu_batches")
+_M_TPU_SIGS = metrics.counter("crypto.tpu_sigs")
+_M_CPU_BATCHES = metrics.counter("crypto.cpu_batches")
+_M_CPU_SIGS = metrics.counter("crypto.cpu_sigs")
+_M_BATCH_SIZE = metrics.histogram("crypto.batch_size", metrics.SIZE_BUCKETS)
 
 
 class TpuBackend(CryptoBackend):
@@ -118,14 +127,19 @@ class TpuBackend(CryptoBackend):
         n = len(messages)
         if n == 0:
             return []
+        _M_BATCH_SIZE.record(n)
         if n < self.crossover:
             with self._lock:
                 self.stats["cpu_batches"] += 1
                 self.stats["cpu_sigs"] += n
+            _M_CPU_BATCHES.inc()
+            _M_CPU_SIGS.inc(n)
             return self._cpu.verify_batch_mask(messages, keys, signatures)
         with self._lock:
             self.stats["tpu_batches"] += 1
             self.stats["tpu_sigs"] += n
+        _M_TPU_BATCHES.inc()
+        _M_TPU_SIGS.inc(n)
         mask = self._verifier.verify_batch_mask(
             list(messages),
             [k.data for k in keys],

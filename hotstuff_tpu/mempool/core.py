@@ -19,6 +19,7 @@ import os
 from ..crypto import Digest, PublicKey, Signature, generate_keypair
 from ..network.net import NetMessage
 from ..store import Store
+from ..utils import metrics
 from ..utils.actors import Selector, spawn
 from ..utils.serde import Reader, Writer
 from ..consensus.mempool_driver import (
@@ -44,6 +45,17 @@ from .synchronizer import Synchronizer
 log = logging.getLogger("hotstuff.mempool")
 
 PAYLOAD_PREFIX = b"payload:"
+
+_M_PAYLOADS_OWN = metrics.counter("mempool.payloads_own")
+_M_PAYLOADS_OTHER = metrics.counter("mempool.payloads_other")
+_M_PAYLOAD_BYTES = metrics.counter("mempool.payload_bytes")
+_M_REQUESTS_SERVED = metrics.counter("mempool.payload_requests_served")
+_M_GOSSIP_DROPPED = metrics.counter("mempool.gossip_dropped")
+_M_SYNTHETIC_SKIPPED = metrics.counter("mempool.synthetic_skipped")
+_M_REQUESTS_CLAMPED = metrics.counter("mempool.requests_clamped")
+_M_VERIFY_BATCH = metrics.histogram(
+    "mempool.verify_batch_size", metrics.SIZE_BUCKETS
+)
 
 
 class SyntheticPool:
@@ -161,6 +173,7 @@ class Core:
             # PayloadRequests — the recovery path consensus stalls on.
             before = self._synthetic_skipped
             self._synthetic_skipped += n
+            _M_SYNTHETIC_SKIPPED.inc(n)
             if before == 0 or before // 25_000 != self._synthetic_skipped // 25_000:
                 log.warning(
                     "verification pipeline saturated: %s synthetic workload "
@@ -170,6 +183,7 @@ class Core:
                 )
             return
         log.info("Verifying %s transaction batch. Size: %s", kind, n)
+        _M_VERIFY_BATCH.record(n)
         msgs, pairs = self.pool.take(n)
         await self._spawn_verification(self._run_synthetic, msgs, pairs)
 
@@ -206,6 +220,8 @@ class Core:
 
     async def _handle_own_payload(self, payload: Payload) -> Digest:
         digest = payload.digest()
+        _M_PAYLOADS_OWN.inc()
+        _M_PAYLOAD_BYTES.inc(payload.size())
         await self._submit_synthetic_batch("OWN", len(payload.transactions))
         # NOTE: These log entries are used to compute performance.
         log.info("Payload %s contains %s B", digest, payload.size())
@@ -250,6 +266,7 @@ class Core:
         # anything consensus actually needs.
         if self._accept_sem.locked():
             self._gossip_dropped += 1
+            _M_GOSSIP_DROPPED.inc()
             if self._gossip_dropped % 1_000 == 1:
                 log.warning(
                     "payload acceptance bound full: %s gossiped payloads "
@@ -265,6 +282,8 @@ class Core:
         ok = await payload.verify_async(self.committee, self.verification_service)
         if not ok:
             raise InvalidPayloadSignatureError(payload.author.short())
+        _M_PAYLOADS_OTHER.inc()
+        _M_PAYLOAD_BYTES.inc(payload.size())
         # Store + queue as soon as the REAL signature verifies: consensus
         # blocks on payload availability, and the synthetic workload below is
         # pure load whose result never gates acceptance (the reference
@@ -300,6 +319,7 @@ class Core:
         cap = self.parameters.max_request_digests
         if len(digests) > cap:
             self._requests_clamped += 1
+            _M_REQUESTS_CLAMPED.inc()
             if self._requests_clamped % 1_000 == 1:
                 log.warning(
                     "clamping oversized payload request (%s digests) from "
@@ -315,6 +335,7 @@ class Core:
         for digest in digests:
             raw = await self.store.read(PAYLOAD_PREFIX + digest.data)
             if raw is not None:
+                _M_REQUESTS_SERVED.inc()
                 payload = Payload.decode(Reader(raw))
                 # Urgent: the requester's consensus is stalled on this
                 # payload; behind the gossip backlog it would drop and the

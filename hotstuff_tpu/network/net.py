@@ -26,11 +26,21 @@ import struct
 from dataclasses import dataclass
 from typing import Callable
 
+from ..utils import metrics
 from ..utils.actors import Selector, channel, spawn
 
 log = logging.getLogger("hotstuff.network")
 
 Address = tuple[str, int]
+
+_M_BYTES_SENT = metrics.counter("net.bytes_sent")
+_M_FRAMES_SENT = metrics.counter("net.frames_sent")
+_M_BYTES_RECEIVED = metrics.counter("net.bytes_received")
+_M_FRAMES_RECEIVED = metrics.counter("net.frames_received")
+_M_SEND_FAILURES = metrics.counter("net.send_failures")
+_M_RECONNECTS = metrics.counter("net.reconnects")
+_M_DROPPED_FULL = metrics.counter("net.dropped_full")
+_M_DECODE_ERRORS = metrics.counter("net.decode_errors")
 
 MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
 
@@ -172,6 +182,7 @@ class NetSender:
                     q.put_nowait(payload)
                 except asyncio.QueueFull:
                     # Fire-and-forget: drop rather than block the fan-out.
+                    _M_DROPPED_FULL.inc()
                     log.debug("dropping message to %s: peer queue full", addr)
 
     async def _worker(
@@ -189,18 +200,26 @@ class NetSender:
         selector.add("hot", hot.get)
         selector.add("cold", cold.get, priority=1)
         writer: asyncio.StreamWriter | None = None
+        connected_before = False  # reconnects = churn, not initial connects
         while True:
             _branch, payload = await selector.next()
             if writer is None:
                 try:
                     _, writer = await asyncio.open_connection(addr[0], addr[1])
+                    if connected_before:
+                        _M_RECONNECTS.inc()
+                    connected_before = True
                 except OSError as e:
+                    _M_SEND_FAILURES.inc()
                     log.debug("failed to connect to %s: %s", addr, e)
                     continue  # drop this message
             try:
                 writer.write(payload)
                 await writer.drain()
+                _M_FRAMES_SENT.inc()
+                _M_BYTES_SENT.inc(len(payload))
             except (ConnectionError, OSError) as e:
+                _M_SEND_FAILURES.inc()
                 log.debug("failed to send to %s: %s", addr, e)
                 try:
                     writer.close()
@@ -249,9 +268,12 @@ class NetReceiver:
                 break
             if data is None:
                 break
+            _M_FRAMES_RECEIVED.inc()
+            _M_BYTES_RECEIVED.inc(len(data) + 4)  # + length prefix
             try:
                 message = self._decode(data)
             except Exception as e:
+                _M_DECODE_ERRORS.inc()
                 log.warning("%s: undecodable frame from %s: %r", self._name, peer, e)
                 continue
             await self._deliver.put(message)

@@ -141,6 +141,12 @@ def main(argv: list[str] | None = None) -> None:
         action="store_true",
         help="skip pre-compiling device kernels before joining consensus",
     )
+    p_run.add_argument(
+        "--metrics-out",
+        default=None,
+        help="write the structured metrics dump (utils/metrics.py) to this "
+        "path on exit/SIGTERM",
+    )
 
     p_deploy = sub.add_parser("deploy", help="in-process local testbed")
     p_deploy.add_argument("--nodes", type=int, required=True)
@@ -164,10 +170,38 @@ def main(argv: list[str] | None = None) -> None:
             "ignoring malformed HOTSTUFF_SWITCH_INTERVAL"
         )
 
+    # Exit-time flushers, shared by the profiler and --metrics-out: the
+    # benchmark harness stops nodes with SIGTERM, which skips atexit by
+    # default, so both hooks ride one SIGTERM handler + one atexit.
+    flushers = []
+    if args.command == "run":
+        from ..utils import metrics
+
+        # Periodic `METRICS {json}` snapshot line on hotstuff.metrics
+        # (scraped by benchmark.logs.LogParser); <= 0 disables.
+        try:
+            interval = float(os.environ.get("HOTSTUFF_METRICS_INTERVAL", "5"))
+        except ValueError:
+            logging.getLogger("hotstuff.metrics").warning(
+                "ignoring malformed HOTSTUFF_METRICS_INTERVAL"
+            )
+            interval = 5.0
+        metrics.start_periodic_emitter(interval)
+        if args.metrics_out:
+
+            def _write_metrics():
+                try:
+                    metrics.write_json(args.metrics_out)
+                except OSError as e:
+                    logging.getLogger("hotstuff.metrics").warning(
+                        "failed to write metrics dump: %r", e
+                    )
+
+            flushers.append(_write_metrics)
+
     # HOTSTUFF_PROFILE=<path>: run the node under cProfile and dump stats
     # to <path>.<pid> on SIGTERM/exit (SURVEY §5.5 observability; used by
     # the protocol-plane ceiling analysis in data/profiles/).
-    profile_path = None
     if args.command == "run" and os.environ.get("HOTSTUFF_PROFILE"):
         import cProfile
 
@@ -175,16 +209,26 @@ def main(argv: list[str] | None = None) -> None:
         profiler = cProfile.Profile()
         profiler.enable()
 
+        def _dump_profile():
+            profiler.disable()
+            profiler.dump_stats(profile_path)
+
+        flushers.append(_dump_profile)
+
+    if flushers:
         import atexit
         import signal
 
-        def _dump(*_a):
-            profiler.disable()
-            profiler.dump_stats(profile_path)
+        def _flush_all():
+            for flush in flushers:
+                flush()
+
+        def _on_term(*_a):
+            _flush_all()
             os._exit(0)
 
-        signal.signal(signal.SIGTERM, _dump)
-        atexit.register(lambda: profiler.dump_stats(profile_path))
+        signal.signal(signal.SIGTERM, _on_term)
+        atexit.register(_flush_all)
 
     if args.command == "keys":
         _cmd_keys(args)

@@ -43,8 +43,25 @@ import numpy as np
 from jax import lax
 
 from . import field as f
+from ..utils import metrics
 
 log = logging.getLogger("hotstuff.ops")
+
+# Stage-tracing handles (names match tools/profile_e2e.py's phase rows; see
+# the COMPONENTS.md metric table). `readback_s` times the single
+# device->host mask fetch, which in the pipelined path also drains the
+# device compute queue — profile_e2e.py separates compute from readback by
+# probing phases in isolation, which an in-process span cannot.
+_M_STAGE = metrics.histogram("verifier.stage_s")
+_M_UPLOAD = metrics.histogram("verifier.upload_s")
+_M_DISPATCH = metrics.histogram("verifier.dispatch_s")
+_M_READBACK = metrics.histogram("verifier.readback_s")
+_M_E2E = metrics.histogram("verifier.e2e_s")
+_M_BATCH_SIZE = metrics.histogram("verifier.batch_size", metrics.SIZE_BUCKETS)
+_M_SIGS = metrics.counter("verifier.sigs")
+_M_BATCHES = metrics.counter("verifier.batches")
+_M_CHUNKS = metrics.counter("verifier.chunks")
+_M_DH_FALLBACKS = metrics.counter("verifier.device_hash_fallbacks")
 
 P = f.P
 L_ORDER = 2**252 + 27742317777372353535851937790883648493
@@ -586,7 +603,10 @@ def _upload_dispatch(fn, padded: np.ndarray, put=None):
     so the jitted shard_map never reshards a device-0 array)."""
     import jax as _jax
 
-    return fn((put or _jax.device_put)(padded))
+    with metrics.span(_M_UPLOAD):
+        dev = (put or _jax.device_put)(padded)
+    with metrics.span(_M_DISPATCH):
+        return fn(dev)
 
 
 class Ed25519TpuVerifier:
@@ -661,6 +681,14 @@ class Ed25519TpuVerifier:
         n = len(messages)
         if n == 0:
             return np.empty(0, bool)
+        _M_BATCHES.inc()
+        _M_SIGS.inc(n)
+        _M_BATCH_SIZE.record(n)
+        with metrics.span(_M_E2E):
+            return self._verify_batch_mask(messages, keys, signatures)
+
+    def _verify_batch_mask(self, messages, keys, signatures) -> np.ndarray:
+        n = len(messages)
         if not self.packed:
             out = np.empty(n, bool)
             for lo in range(0, n, self.max_bucket):
@@ -689,6 +717,7 @@ class Ed25519TpuVerifier:
             log.exception(
                 "device-hash kernel failed; retrying with host hashing"
             )
+            _M_DH_FALLBACKS.inc()
             out = self._run_packed(messages, keys, signatures, False)
             self._device_hash_ok = False
             return out
@@ -701,9 +730,11 @@ class Ed25519TpuVerifier:
         futs, oks, spans = [], [], []
         for lo in range(0, n, self.chunk):
             hi = min(lo + self.chunk, n)
-            staged = stage(
-                messages[lo:hi], keys[lo:hi], signatures[lo:hi]
-            )
+            _M_CHUNKS.inc()
+            with metrics.span(_M_STAGE):
+                staged = stage(
+                    messages[lo:hi], keys[lo:hi], signatures[lo:hi]
+                )
             width = self._bucket(hi - lo)
             futs.append(
                 up.submit(
@@ -714,7 +745,8 @@ class Ed25519TpuVerifier:
             spans.append((lo, hi, width))
         masks = [f.result() for f in futs]
         out = np.empty(n, bool)
-        full = self._materialize(masks)
+        with metrics.span(_M_READBACK):
+            full = self._materialize(masks)
         off = 0
         for (lo, hi, width), ok in zip(spans, oks):
             out[lo:hi] = full[off : off + hi - lo] & ok
@@ -730,12 +762,16 @@ class Ed25519TpuVerifier:
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)
-        staged = prepare_batch(
-            messages, keys, signatures, want_bits=self.kernel == "bits"
-        )
+        _M_CHUNKS.inc()
+        with metrics.span(_M_STAGE):
+            staged = prepare_batch(
+                messages, keys, signatures, want_bits=self.kernel == "bits"
+            )
         width = self._bucket(n)
         mask = _verify_jit_args(staged, width, self.kernel)
-        return np.asarray(mask)[:n] & staged["s_ok"]
+        with metrics.span(_M_READBACK):
+            host = np.asarray(mask)
+        return host[:n] & staged["s_ok"]
 
 
 def kernel_args(staged: dict, width: int, kernel: str = "w4") -> tuple:

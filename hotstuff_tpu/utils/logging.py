@@ -21,6 +21,7 @@ class UtcMsFormatter(logging.Formatter):
 
 
 _LEVEL = logging.INFO  # last level chosen by setup_logging
+_HANDLER: logging.Handler | None = None  # last handler installed by it
 
 
 def _level_of(verbosity: int) -> int:
@@ -30,13 +31,18 @@ def _level_of(verbosity: int) -> int:
 
 def setup_logging(verbosity: int = 2, stream=None) -> None:
     """-v count -> level, like env_logger (node/src/main.rs:43-53):
-    0=ERROR, 1=WARNING, 2=INFO, 3+=DEBUG. Logs go to stderr."""
-    global _LEVEL
+    0=ERROR, 1=WARNING, 2=INFO, 3+=DEBUG. Logs go to stderr.
+
+    The installed handler (and thus the chosen stream) is remembered so
+    `quiet_jax_logs` can re-assert it after a device plugin reconfigures
+    the root logger mid-run."""
+    global _LEVEL, _HANDLER
     level = _LEVEL = _level_of(verbosity)
     handler = logging.StreamHandler(stream or sys.stderr)
     handler.setFormatter(
         UtcMsFormatter("[%(asctime)s %(levelname)s %(name)s] %(message)s")
     )
+    _HANDLER = handler
     root = logging.getLogger()
     root.handlers.clear()
     root.addHandler(handler)
@@ -46,9 +52,10 @@ def setup_logging(verbosity: int = 2, stream=None) -> None:
 def quiet_jax_logs(verbosity: int = 2) -> None:
     """Cap jax's internal loggers (compilation-cache tracing logs every key
     lookup at DEBUG, duplicated by jax's own stderr handler — tens of MB per
-    benchmark run) and re-assert the root level: the TPU device plugin
-    flips the root logger to DEBUG during device init. Call AFTER
-    `import jax`, and again after the first device dispatch."""
+    benchmark run) and re-assert the root logging config: the TPU device
+    plugin flips the root logger to DEBUG (and may swap handlers) during
+    device init. Idempotent and re-callable: call AFTER `import jax`, and
+    again after the first device dispatch."""
     level = logging.WARNING if verbosity < 3 else logging.DEBUG
     for name in ("jax", "jaxlib"):
         lg = logging.getLogger(name)
@@ -60,4 +67,10 @@ def quiet_jax_logs(verbosity: int = 2) -> None:
             lg.setLevel(logging.NOTSET)  # inherit from the capped parent
             lg.handlers.clear()
             lg.propagate = True
-    logging.getLogger().setLevel(_LEVEL)
+    root = logging.getLogger()
+    if _HANDLER is not None and _HANDLER not in root.handlers:
+        # Device init dropped the handler setup_logging installed: restore
+        # it (same instance, same stream) so the LogParser line contract
+        # survives a mid-run logging reconfiguration.
+        root.addHandler(_HANDLER)
+    root.setLevel(_LEVEL)

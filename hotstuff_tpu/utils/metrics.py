@@ -1,0 +1,521 @@
+"""In-process metrics registry + stage tracing for the consensus/TPU hot paths.
+
+Every performance claim so far came from ad-hoc timers (`tools/profile_e2e.py`
+exists because a 2.1x device-vs-e2e gap was asserted before it was measured);
+this module makes per-stage breakdowns a permanent, machine-readable artifact:
+
+  * `counter(name)` / `gauge(name)` / `histogram(name)` — get-or-create
+    metrics in a process-global registry. Counters are monotonic; histograms
+    use FIXED bucket bounds (no per-sample storage) and derive p50/p95/p99
+    by interpolation inside the owning bucket, so recording is O(log buckets)
+    and memory is O(buckets) no matter how hot the path.
+  * `span(hist)` context manager and `@timed(name)` decorator — stage
+    tracing; a span records wall seconds into its histogram on exit.
+  * `snapshot_json()` — one compact JSON object (no raw buckets) for the
+    periodic `METRICS {json}` log line that `benchmark.logs.LogParser`
+    scrapes; `dump()` / `write_json(path)` — the full structured artifact
+    (`bench.py --metrics-out`, `node run --metrics-out`).
+  * `start_periodic_emitter(interval_s)` — a daemon thread logging the
+    snapshot line on `hotstuff.metrics` at INFO.
+
+Thread-safety: every metric guards its state with its own lock — the
+verifier's upload/dispatch threads, the BatchVerificationService worker
+threads, and the asyncio actor loops all record concurrently.
+
+Overhead: recording is gated on a module-level flag (`HOTSTUFF_METRICS=0`
+disables it); when disabled, `inc`/`record`/`span` are a single global read
+and an early return — no lock, no clock read.
+
+The canonical metric namespace is registered eagerly at import
+(`_DEFAULT_NAMESPACE` below, documented in COMPONENTS.md), so a `dump()`
+always carries the full schema — zeros included — even in processes that
+never exercise (or cannot import) a given layer. Layer modules re-request
+the same names via get-or-create, which keeps handles and schema in sync.
+
+Dependency-free by design: stdlib only, no jax, no package-internal imports.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import logging
+import os
+import threading
+import time
+from bisect import bisect_left
+from typing import Callable, Sequence
+
+log = logging.getLogger("hotstuff.metrics")
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "TIME_BUCKETS_S",
+    "SIZE_BUCKETS",
+    "counter",
+    "gauge",
+    "histogram",
+    "span",
+    "timed",
+    "enabled",
+    "enable",
+    "dump",
+    "snapshot_json",
+    "emit_snapshot",
+    "write_json",
+    "reset",
+    "start_periodic_emitter",
+]
+
+# Wall-seconds buckets (1-2-5 series, 10 us .. 60 s): spans from sub-ms
+# kernel dispatches up to multi-second cold compiles land in distinct rows.
+TIME_BUCKETS_S: tuple[float, ...] = (
+    1e-5, 2e-5, 5e-5, 1e-4, 2e-4, 5e-4, 1e-3, 2e-3, 5e-3,
+    1e-2, 2e-2, 5e-2, 0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0,
+)
+
+# Power-of-two buckets for batch/queue sizes (1 .. 128k — the verifier's
+# bucket widths are powers of two, so each width is its own row).
+SIZE_BUCKETS: tuple[float, ...] = tuple(float(2**i) for i in range(18))
+
+_enabled = os.environ.get("HOTSTUFF_METRICS", "1") != "0"
+
+# Metric locks are RE-ENTRANT: the node's SIGTERM handler flushes a dump()
+# on the interrupted main thread, which may be parked inside a record()'s
+# critical section — a plain Lock would deadlock the exit path (a torn read
+# of one in-flight sample is acceptable for a final snapshot; a hang is not).
+_new_lock = threading.RLock
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Flip recording globally (registration is always allowed)."""
+    global _enabled
+    _enabled = on
+
+
+class Counter:
+    """Monotonic counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = _new_lock()
+
+    def inc(self, n: int = 1) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0
+
+
+class Gauge:
+    """Last-write-wins scalar (e.g. the current consensus round)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0.0
+        self._lock = _new_lock()
+
+    def set(self, v: float) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value = v
+
+    def add(self, v: float) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram:
+    """Fixed-bucket histogram with interpolated percentiles.
+
+    `bounds` are the inclusive upper edges of the finite buckets; one
+    overflow bucket catches everything above the last bound. Percentiles
+    interpolate linearly inside the owning bucket (clamped to the observed
+    min/max at the edges), so their error is bounded by the bucket width —
+    the resolution contract callers pick via `buckets`.
+    """
+
+    __slots__ = ("name", "bounds", "_counts", "_count", "_sum", "_min", "_max", "_lock")
+
+    def __init__(self, name: str, buckets: Sequence[float] = TIME_BUCKETS_S) -> None:
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError(f"histogram {name}: buckets must be sorted and non-empty")
+        self.name = name
+        self.bounds = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 overflow
+        self._count = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._lock = _new_lock()
+
+    def record(self, v: float) -> None:
+        if not _enabled:
+            return
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _snapshot(self) -> tuple[list[int], int, float, float, float]:
+        """One locked copy of (bucket counts, count, sum, min, max)."""
+        with self._lock:
+            return (
+                list(self._counts), self._count, self._sum, self._min, self._max
+            )
+
+    def _percentile_from(
+        self, counts: list[int], total: int, lo_obs: float, hi_obs: float,
+        q: float,
+    ) -> float:
+        target = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c and cum + c >= target:
+                lo = self.bounds[i - 1] if i > 0 else lo_obs
+                hi = self.bounds[i] if i < len(self.bounds) else hi_obs
+                lo = max(lo, lo_obs)  # clamp edges to the observed range
+                hi = max(min(hi, hi_obs), lo)
+                frac = (target - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return hi_obs
+
+    def percentile(self, q: float) -> float:
+        """q in [0, 1] -> interpolated value; 0.0 on an empty histogram."""
+        counts, total, _s, lo_obs, hi_obs = self._snapshot()
+        if total == 0:
+            return 0.0
+        return self._percentile_from(counts, total, lo_obs, hi_obs, q)
+
+    def summary(self) -> dict:
+        """All fields derive from ONE locked snapshot, so concurrent
+        recording cannot yield an internally inconsistent summary (e.g.
+        p95 < p50, or a count matching none of the percentile bases)."""
+        counts, total, s, lo, hi = self._snapshot()
+        if total == 0:
+            return {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+        pct = lambda q: self._percentile_from(counts, total, lo, hi, q)
+        return {
+            "count": total,
+            "sum": s,
+            "min": lo,
+            "max": hi,
+            "mean": s / total,
+            "p50": pct(0.50),
+            "p95": pct(0.95),
+            "p99": pct(0.99),
+        }
+
+    def buckets_dict(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+        return {"le": list(self.bounds) + ["+inf"], "counts": counts}
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+            self._min = float("inf")
+            self._max = float("-inf")
+
+
+class _Span:
+    """Context manager timing one stage into a histogram (see `span`)."""
+
+    __slots__ = ("_hist", "_t0")
+
+    def __init__(self, hist: Histogram) -> None:
+        self._hist = hist
+        self._t0 = None
+
+    def __enter__(self) -> "_Span":
+        if _enabled:
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._t0 is not None and _enabled:
+            self._hist.record(time.perf_counter() - self._t0)
+        self._t0 = None
+
+
+class Registry:
+    """Named metrics, get-or-create. One process-global default below."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = _new_lock()
+
+    def _get_or_create(self, name: str, kind, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {kind.__name__}"
+                )
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter, lambda: Counter(name))
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge, lambda: Gauge(name))
+
+    def histogram(
+        self, name: str, buckets: Sequence[float] = TIME_BUCKETS_S
+    ) -> Histogram:
+        return self._get_or_create(name, Histogram, lambda: Histogram(name, buckets))
+
+    def dump(self, include_buckets: bool = True) -> dict:
+        """Full structured artifact (the `--metrics-out` JSON)."""
+        counters, gauges, hists = {}, {}, {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Counter):
+                counters[m.name] = m.value
+            elif isinstance(m, Gauge):
+                gauges[m.name] = m.value
+            else:
+                summary = m.summary()
+                if include_buckets:
+                    summary["buckets"] = m.buckets_dict()
+                hists[m.name] = summary
+        return {
+            "v": 1,
+            "enabled": _enabled,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+
+    def snapshot_json(self) -> str:
+        """Compact one-line JSON (summaries only) for the METRICS log line."""
+        return json.dumps(
+            self.dump(include_buckets=False), separators=(",", ":"), sort_keys=True
+        )
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.dump(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def reset(self) -> None:
+        """Zero every metric; registrations are kept (test isolation)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+
+REGISTRY = Registry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets: Sequence[float] = TIME_BUCKETS_S) -> Histogram:
+    return REGISTRY.histogram(name, buckets)
+
+
+def span(hist: Histogram | str) -> _Span:
+    """`with metrics.span(h): ...` — time the block into histogram `h`.
+    Hot paths should pass a pre-created Histogram handle (a string does a
+    registry lookup per call)."""
+    if isinstance(hist, str):
+        hist = REGISTRY.histogram(hist)
+    return _Span(hist)
+
+
+def timed(name: str, buckets: Sequence[float] = TIME_BUCKETS_S) -> Callable:
+    """Decorator form of `span`: records each call's wall seconds."""
+    h = REGISTRY.histogram(name, buckets)
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _enabled:
+                return fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                h.record(time.perf_counter() - t0)
+
+        return wrapper
+
+    return deco
+
+
+def dump(include_buckets: bool = True) -> dict:
+    return REGISTRY.dump(include_buckets)
+
+
+def snapshot_json() -> str:
+    return REGISTRY.snapshot_json()
+
+
+def write_json(path: str) -> None:
+    REGISTRY.write_json(path)
+
+
+def reset() -> None:
+    REGISTRY.reset()
+
+
+def emit_snapshot() -> None:
+    """Log one `METRICS {json}` line (the LogParser scraping contract)."""
+    log.info("METRICS %s", snapshot_json())
+
+
+_emitter_stop: threading.Event | None = None
+_emitter_lock = threading.Lock()
+
+
+def start_periodic_emitter(interval_s: float = 5.0) -> threading.Event | None:
+    """Emit a snapshot line every `interval_s` from a daemon thread; returns
+    the stop event (set() to halt), or None when interval <= 0 or an emitter
+    is already running."""
+    global _emitter_stop
+    if interval_s <= 0:
+        return None
+    with _emitter_lock:
+        if _emitter_stop is not None and not _emitter_stop.is_set():
+            return None
+        stop = _emitter_stop = threading.Event()
+
+    def _loop() -> None:
+        while not stop.wait(interval_s):
+            if _enabled:
+                emit_snapshot()
+
+    threading.Thread(target=_loop, name="metrics-emitter", daemon=True).start()
+    return stop
+
+
+# --- canonical namespace ----------------------------------------------------
+#
+# (name, kind, buckets) — the schema of record, documented as the metric
+# naming table in COMPONENTS.md. Registered eagerly so every dump carries
+# the full schema with zeros for layers the process never exercised.
+
+_DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
+    # ops/ed25519.py + crypto/tpu_backend.py — verifier hot path
+    ("verifier.stage_s", "histogram", None),
+    ("verifier.upload_s", "histogram", None),
+    ("verifier.dispatch_s", "histogram", None),
+    ("verifier.readback_s", "histogram", None),
+    ("verifier.e2e_s", "histogram", None),
+    ("verifier.batch_size", "histogram", SIZE_BUCKETS),
+    ("verifier.sigs", "counter", None),
+    ("verifier.batches", "counter", None),
+    ("verifier.chunks", "counter", None),
+    ("verifier.device_hash_fallbacks", "counter", None),
+    ("crypto.tpu_batches", "counter", None),
+    ("crypto.tpu_sigs", "counter", None),
+    ("crypto.cpu_batches", "counter", None),
+    ("crypto.cpu_sigs", "counter", None),
+    ("crypto.batch_size", "histogram", SIZE_BUCKETS),
+    # consensus/core.py + aggregator.py + synchronizer.py
+    ("consensus.proposals", "counter", None),
+    ("consensus.votes", "counter", None),
+    ("consensus.commits", "counter", None),
+    ("consensus.timeouts", "counter", None),
+    ("consensus.qcs", "counter", None),
+    ("consensus.tcs", "counter", None),
+    ("consensus.sync_requests", "counter", None),
+    ("consensus.sync_retries", "counter", None),
+    ("consensus.sync_requests_served", "counter", None),
+    ("consensus.round", "gauge", None),
+    ("consensus.proposal_to_vote_s", "histogram", None),
+    ("consensus.qc_form_s", "histogram", None),
+    ("consensus.tc_form_s", "histogram", None),
+    ("consensus.commit_latency_s", "histogram", None),
+    # mempool/core.py
+    ("mempool.payloads_own", "counter", None),
+    ("mempool.payloads_other", "counter", None),
+    ("mempool.payload_bytes", "counter", None),
+    ("mempool.payload_requests_served", "counter", None),
+    ("mempool.gossip_dropped", "counter", None),
+    ("mempool.synthetic_skipped", "counter", None),
+    ("mempool.requests_clamped", "counter", None),
+    ("mempool.verify_batch_size", "histogram", SIZE_BUCKETS),
+    # network/net.py
+    ("net.bytes_sent", "counter", None),
+    ("net.frames_sent", "counter", None),
+    ("net.bytes_received", "counter", None),
+    ("net.frames_received", "counter", None),
+    ("net.send_failures", "counter", None),
+    ("net.reconnects", "counter", None),
+    ("net.dropped_full", "counter", None),
+    ("net.decode_errors", "counter", None),
+)
+
+
+def register_defaults(registry: Registry | None = None) -> None:
+    r = registry or REGISTRY
+    for name, kind, buckets in _DEFAULT_NAMESPACE:
+        if kind == "counter":
+            r.counter(name)
+        elif kind == "gauge":
+            r.gauge(name)
+        else:
+            r.histogram(name, buckets or TIME_BUCKETS_S)
+
+
+register_defaults()

@@ -81,7 +81,8 @@ def test_aggregate_means_and_family_files(results_dir):
     assert agg[key]["e2e_tps"]["stdev"] > 0
     for name in ("aggregated.txt", "agg-latency.txt", "agg-robustness.txt", "agg-tps.txt"):
         assert os.path.exists(os.path.join(results_dir, name)), name
-    tps = open(os.path.join(results_dir, "agg-tps.txt")).read()
+    with open(os.path.join(results_dir, "agg-tps.txt")) as f:
+        tps = f.read()
     # under a 2s SLO the saturated 20k point must NOT win for 4 nodes
     assert "max_latency_ms=2000 nodes=4 best_tps=9800" in tps
     # faulty runs are excluded from the SLO family
@@ -219,3 +220,86 @@ def test_log_parser_reports_workload_shed():
     p = LogParser([CLIENT_LOG], [node])
     assert p.workload_shed == 200390  # LAST cumulative value, not a sum
     assert "Workload shed at saturation: >= 200,390 sigs" in p.result()
+
+
+# ---------------------------------------------------------------------------
+# LogParser: METRICS snapshot scraping (utils/metrics.py periodic emitter)
+
+
+def _metrics_line(ts: str, counters: dict, histograms: dict | None = None) -> str:
+    import json
+
+    snap = {
+        "v": 1,
+        "counters": counters,
+        "gauges": {},
+        "histograms": histograms or {},
+    }
+    return (
+        f"[{ts} INFO hotstuff.metrics] METRICS "
+        + json.dumps(snap, separators=(",", ":"))
+        + "\n"
+    )
+
+
+def test_log_parser_scrapes_metrics_snapshots_interleaved():
+    """Cumulative snapshots interleave with Committed/Verifying lines; the
+    LAST snapshot per node wins, counters sum across nodes, and the
+    existing metrics are unaffected."""
+    from benchmark.logs import LogParser
+
+    node1 = (
+        NODE_LOG
+        + _metrics_line("2026-07-30T10:00:01.500Z", {"consensus.commits": 1})
+        + "[2026-07-30T10:00:02.500Z INFO hotstuff.consensus] Committed B2(b2=)\n"
+        + _metrics_line(
+            "2026-07-30T10:00:03.000Z",
+            {"consensus.commits": 2, "net.bytes_sent": 4096},
+            {"verifier.e2e_s": {"count": 4, "sum": 0.08, "max": 0.03}},
+        )
+    )
+    node2 = NODE_LOG + _metrics_line(
+        "2026-07-30T10:00:03.000Z",
+        {"consensus.commits": 2},
+        {"verifier.e2e_s": {"count": 1, "sum": 0.02, "max": 0.02}},
+    )
+    p = LogParser([CLIENT_LOG], [node1, node2])
+    assert len(p.node_metrics) == 2
+    # last-per-node counters summed: 2 + 2, not 1 + 2 + 2
+    assert p.metrics["counters"]["consensus.commits"] == 4
+    assert p.metrics["counters"]["net.bytes_sent"] == 4096
+    h = p.metrics["histograms"]["verifier.e2e_s"]
+    assert h["count"] == 5 and h["sum"] == pytest.approx(0.10)
+    assert h["max"] == pytest.approx(0.03)
+    # the non-metrics scraping still sees every line
+    rate, total = p.verification_throughput()
+    assert total == 2400  # two copies of NODE_LOG
+    out = p.result()
+    assert "+ METRICS (2 node snapshots):" in out
+    assert "consensus.commits: 4" in out
+
+
+def test_log_parser_tolerates_malformed_metrics_snapshot():
+    """A snapshot truncated by SIGTERM mid-line (or otherwise malformed)
+    must be skipped, never raise ParseError; earlier well-formed snapshots
+    still count."""
+    from benchmark.logs import LogParser
+
+    node = (
+        NODE_LOG
+        + _metrics_line("2026-07-30T10:00:01.500Z", {"consensus.commits": 7})
+        + "[2026-07-30T10:00:03.000Z INFO hotstuff.metrics] METRICS {\"counters\":{\"consensus.comm\n"
+        + "[2026-07-30T10:00:04.000Z INFO hotstuff.metrics] METRICS {not json at all}\n"
+    )
+    p = LogParser([CLIENT_LOG], [node])
+    assert len(p.node_metrics) == 1
+    assert p.metrics["counters"]["consensus.commits"] == 7
+
+
+def test_log_parser_no_metrics_lines_yields_empty_aggregate():
+    from benchmark.logs import LogParser
+
+    p = LogParser([CLIENT_LOG], [NODE_LOG])
+    assert p.node_metrics == []
+    assert p.metrics == {"counters": {}, "histograms": {}}
+    assert "+ METRICS" not in p.result()

@@ -1,0 +1,304 @@
+"""Metrics registry + stage tracing (hotstuff_tpu/utils/metrics.py): counter
+and histogram correctness, percentile math against a known distribution,
+thread-safety under concurrent recording, disabled-mode no-op behavior, the
+snapshot/dump formats the LogParser and `--metrics-out` rely on, and the
+utils/logging.py re-assertion contract. Marker-free: tier-1, no jax, no
+crypto deps."""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import threading
+
+import pytest
+
+from hotstuff_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    """Zero the process-global registry around each test (handles persist)."""
+    metrics.reset()
+    metrics.enable(True)
+    yield
+    metrics.enable(True)
+    metrics.reset()
+
+
+# --- counters / gauges ------------------------------------------------------
+
+
+def test_counter_monotonic_and_get_or_create():
+    c = metrics.counter("test.c")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    assert metrics.counter("test.c") is c  # get-or-create returns the handle
+
+
+def test_gauge_set_and_add():
+    g = metrics.gauge("test.g")
+    g.set(7.5)
+    g.add(2.5)
+    assert g.value == 10.0
+
+
+def test_kind_conflict_raises():
+    metrics.counter("test.kind")
+    with pytest.raises(TypeError):
+        metrics.gauge("test.kind")
+
+
+# --- histograms -------------------------------------------------------------
+
+
+def test_histogram_basic_stats():
+    h = metrics.histogram("test.h", buckets=[1.0, 2.0, 5.0, 10.0])
+    for v in (0.5, 1.5, 3.0, 7.0, 20.0):
+        h.record(v)
+    s = h.summary()
+    assert s["count"] == 5
+    assert s["sum"] == pytest.approx(32.0)
+    assert s["min"] == 0.5 and s["max"] == 20.0
+    assert s["mean"] == pytest.approx(6.4)
+
+
+def test_histogram_percentiles_uniform_distribution():
+    """Percentiles against a known distribution: uniform 1..1000 into
+    10-wide buckets — interpolated p50/p95/p99 must land within one bucket
+    width of the exact order statistics."""
+    h = metrics.histogram(
+        "test.pct", buckets=[float(x) for x in range(10, 1001, 10)]
+    )
+    for v in range(1, 1001):
+        h.record(float(v))
+    s = h.summary()
+    assert abs(s["p50"] - 500.0) <= 10.0
+    assert abs(s["p95"] - 950.0) <= 10.0
+    assert abs(s["p99"] - 990.0) <= 10.0
+
+
+def test_histogram_single_value_and_empty():
+    h = metrics.histogram("test.single")
+    assert h.summary()["p99"] == 0.0  # empty: all zeros, no NaN/inf
+    h.record(0.003)
+    s = h.summary()
+    assert s["count"] == 1
+    assert 0.002 <= s["p50"] <= 0.003  # clamped to the observed range
+    assert s["min"] == s["max"] == pytest.approx(0.003)
+
+
+def test_histogram_overflow_bucket():
+    h = metrics.histogram("test.over", buckets=[1.0])
+    h.record(100.0)
+    s = h.summary()
+    assert s["count"] == 1 and s["max"] == 100.0
+    assert s["p50"] == pytest.approx(100.0)  # overflow clamps to max
+
+
+def test_histogram_rejects_unsorted_buckets():
+    with pytest.raises(ValueError):
+        metrics.Histogram("bad", buckets=[2.0, 1.0])
+
+
+# --- spans / timed ----------------------------------------------------------
+
+
+def test_span_records_duration():
+    h = metrics.histogram("test.span")
+    with metrics.span(h):
+        pass
+    with metrics.span("test.span"):  # string form resolves the same metric
+        pass
+    assert h.count == 2
+    assert h.summary()["max"] < 5.0  # sanity: wall-clock, not garbage
+
+
+def test_timed_decorator():
+    @metrics.timed("test.timed")
+    def work(x):
+        return x * 2
+
+    assert work(21) == 42
+    assert metrics.histogram("test.timed").count == 1
+
+
+def test_timed_records_on_exception():
+    @metrics.timed("test.timed_exc")
+    def boom():
+        raise ValueError("x")
+
+    with pytest.raises(ValueError):
+        boom()
+    assert metrics.histogram("test.timed_exc").count == 1
+
+
+# --- disabled mode ----------------------------------------------------------
+
+
+def test_disabled_mode_is_a_noop():
+    c = metrics.counter("test.dis_c")
+    h = metrics.histogram("test.dis_h")
+    g = metrics.gauge("test.dis_g")
+    metrics.enable(False)
+    try:
+        c.inc(10)
+        g.set(5.0)
+        h.record(1.0)
+        with metrics.span(h):
+            pass
+
+        @metrics.timed("test.dis_t")
+        def f():
+            return 1
+
+        f()
+        assert c.value == 0
+        assert g.value == 0.0
+        assert h.count == 0
+        assert metrics.histogram("test.dis_t").count == 0
+    finally:
+        metrics.enable(True)
+    c.inc()
+    assert c.value == 1  # re-enabled recording works
+
+
+def test_span_disabled_mid_flight_does_not_crash():
+    h = metrics.histogram("test.mid")
+    s = metrics.span(h)
+    with s:
+        metrics.enable(False)
+    metrics.enable(True)
+    assert h.count == 0  # flag flipped mid-span: drop, don't crash
+
+
+# --- thread safety ----------------------------------------------------------
+
+
+def test_concurrent_recording_is_lossless():
+    c = metrics.counter("test.mt_c")
+    h = metrics.histogram("test.mt_h")
+    n_threads, per_thread = 8, 5_000
+
+    def work():
+        for _ in range(per_thread):
+            c.inc()
+            h.record(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert h.count == n_threads * per_thread
+    assert h.sum == pytest.approx(n_threads * per_thread * 0.001)
+
+
+# --- snapshot / dump formats ------------------------------------------------
+
+
+def test_snapshot_is_one_line_json_without_buckets():
+    metrics.counter("test.snap").inc(3)
+    line = metrics.snapshot_json()
+    assert "\n" not in line
+    snap = json.loads(line)
+    assert snap["counters"]["test.snap"] == 3
+    for summary in snap["histograms"].values():
+        assert "buckets" not in summary
+
+
+def test_default_namespace_always_present():
+    """The canonical schema (COMPONENTS.md table) is registered at import:
+    a dump from a process that never exercised a layer still carries its
+    metrics as zeros — the `--metrics-out` acceptance contract."""
+    d = metrics.dump()
+    for name in ("verifier.stage_s", "verifier.upload_s", "verifier.e2e_s",
+                 "consensus.commit_latency_s"):
+        assert name in d["histograms"]
+    for name in ("consensus.commits", "consensus.timeouts",
+                 "verifier.sigs", "net.bytes_sent"):
+        assert name in d["counters"]
+    assert "consensus.round" in d["gauges"]
+    assert d["histograms"]["verifier.stage_s"]["buckets"]["counts"]
+
+
+def test_write_json_and_reset(tmp_path):
+    metrics.counter("test.w").inc(9)
+    path = tmp_path / "m.json"
+    metrics.write_json(str(path))
+    d = json.loads(path.read_text())
+    assert d["counters"]["test.w"] == 9
+    metrics.reset()
+    assert metrics.counter("test.w").value == 0
+    assert "test.w" in metrics.dump()["counters"]  # registration survives
+
+
+def test_emit_snapshot_line_contract(caplog):
+    """The periodic emitter's line is exactly what benchmark.logs scrapes:
+    `METRICS {json}` on the hotstuff.metrics logger."""
+    metrics.counter("test.emit").inc(2)
+    with caplog.at_level(logging.INFO, logger="hotstuff.metrics"):
+        metrics.emit_snapshot()
+    msgs = [r.getMessage() for r in caplog.records]
+    assert len(msgs) == 1 and msgs[0].startswith("METRICS {")
+    snap = json.loads(msgs[0][len("METRICS "):])
+    assert snap["counters"]["test.emit"] == 2
+
+
+def test_periodic_emitter_interval_guard():
+    assert metrics.start_periodic_emitter(0) is None
+    stop = metrics.start_periodic_emitter(3600)
+    try:
+        assert stop is not None
+        assert metrics.start_periodic_emitter(3600) is None  # already running
+    finally:
+        stop.set()
+
+
+# --- utils/logging.py: quiet_jax_logs re-assertion (satellite) --------------
+
+
+def _restore_logging():
+    root = logging.getLogger()
+    return root.level, list(root.handlers)
+
+
+def test_quiet_jax_logs_recaps_and_reasserts():
+    """Regression: jax loggers stay capped and the root level/handler are
+    re-asserted on EVERY call (the docstring says to call it twice — device
+    init flips the root logger to DEBUG and may drop handlers)."""
+    from hotstuff_tpu.utils.logging import quiet_jax_logs, setup_logging
+
+    saved_level, saved_handlers = _restore_logging()
+    stream = io.StringIO()
+    try:
+        setup_logging(2, stream=stream)
+        root = logging.getLogger()
+        installed = list(root.handlers)
+        for _ in range(2):  # re-callable: same end state both times
+            # simulate the TPU plugin reconfiguring logging mid-run
+            logging.getLogger("jax").setLevel(logging.DEBUG)
+            logging.getLogger("jax").addHandler(logging.NullHandler())
+            logging.getLogger("jax._src.compiler").setLevel(logging.DEBUG)
+            root.setLevel(logging.DEBUG)
+            root.handlers.clear()
+
+            quiet_jax_logs(2)
+            assert logging.getLogger("jax").level == logging.WARNING
+            assert logging.getLogger("jax").handlers == []
+            assert logging.getLogger("jax._src.compiler").level == logging.NOTSET
+            assert root.level == logging.INFO  # re-asserted from setup_logging
+            assert root.handlers == installed  # remembered handler restored
+        # the restored handler still writes to the remembered stream
+        logging.getLogger("hotstuff.test").info("hello-stream")
+        assert "hello-stream" in stream.getvalue()
+    finally:
+        root = logging.getLogger()
+        root.handlers[:] = saved_handlers
+        root.setLevel(saved_level)
+        logging.getLogger("jax").setLevel(logging.NOTSET)
+        logging.getLogger("jax").handlers.clear()
+        logging.getLogger("jax._src.compiler").setLevel(logging.NOTSET)

@@ -1,0 +1,124 @@
+"""Pretty-print a committed metrics JSON (`--metrics-out` artifacts).
+
+Usage:
+    python tools/metrics_report.py METRICS.json            # one run
+    python tools/metrics_report.py BEFORE.json AFTER.json  # before/after
+
+Renders markdown tables (counters, then histogram summaries) for pasting
+into PR descriptions; with two files, adds delta columns so a perf PR's
+before/after is a diff of committed numbers, not prose.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+_HIST_COLS = ("count", "mean", "p50", "p95", "p99", "max")
+
+
+def _fmt(v: float | int | None) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, int) or float(v).is_integer():
+        return f"{int(v):,}"
+    if abs(v) >= 1:
+        return f"{v:,.2f}"
+    return f"{v:.6g}"
+
+
+def _delta(old, new) -> str:
+    if old is None or new is None:
+        return "-"
+    if old == 0:
+        return "new" if new else "0"
+    return f"{(new - old) / old * 100:+.1f}%"
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict) or "counters" not in d:
+        sys.exit(f"{path}: not a metrics dump (missing 'counters')")
+    return d
+
+
+def report(before: dict, after: dict | None = None, skip_zero: bool = True) -> str:
+    """Markdown report; `after=None` renders a single-run table."""
+    out = []
+    b_counters = before.get("counters", {})
+    a_counters = after.get("counters", {}) if after else {}
+    names = sorted(set(b_counters) | set(a_counters))
+    rows = []
+    for name in names:
+        b, a = b_counters.get(name), a_counters.get(name)
+        if skip_zero and not b and not a:
+            continue
+        if after is None:
+            rows.append(f"| {name} | {_fmt(b)} |")
+        else:
+            rows.append(f"| {name} | {_fmt(b)} | {_fmt(a)} | {_delta(b, a)} |")
+    if rows:
+        out.append("### Counters\n")
+        if after is None:
+            out.append("| metric | value |\n|---|---|")
+        else:
+            out.append("| metric | before | after | delta |\n|---|---|---|---|")
+        out.extend(rows)
+
+    b_hists = before.get("histograms", {})
+    a_hists = after.get("histograms", {}) if after else {}
+    names = sorted(set(b_hists) | set(a_hists))
+    rows = []
+    for name in names:
+        b, a = b_hists.get(name, {}), a_hists.get(name, {})
+        if skip_zero and not b.get("count") and not a.get("count"):
+            continue
+        if after is None:
+            cells = " | ".join(_fmt(b.get(c)) for c in _HIST_COLS)
+            rows.append(f"| {name} | {cells} |")
+        else:
+            # before/after on the latency-shaped columns only
+            cells = " | ".join(
+                f"{_fmt(b.get(c))} / {_fmt(a.get(c))}"
+                for c in ("count", "mean", "p50", "p99")
+            )
+            rows.append(
+                f"| {name} | {cells} | {_delta(b.get('p50'), a.get('p50'))} |"
+            )
+    if rows:
+        out.append("\n### Histograms\n")
+        if after is None:
+            cols = " | ".join(_HIST_COLS)
+            out.append(
+                f"| metric | {cols} |\n|---|" + "---|" * len(_HIST_COLS)
+            )
+        else:
+            out.append(
+                "| metric | count (b/a) | mean (b/a) | p50 (b/a) | "
+                "p99 (b/a) | p50 delta |\n|---|---|---|---|---|---|"
+            )
+        out.extend(rows)
+
+    if not out:
+        return "(no non-zero metrics)"
+    return "\n".join(out)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("before", help="metrics JSON (or the only file)")
+    ap.add_argument("after", nargs="?", default=None, help="optional second "
+                    "metrics JSON for a before/after delta table")
+    ap.add_argument(
+        "--all", action="store_true", help="include zero-valued metrics"
+    )
+    args = ap.parse_args()
+    before = _load(args.before)
+    after = _load(args.after) if args.after else None
+    print(report(before, after, skip_zero=not args.all))
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,12 @@ def main() -> None:
     ap.add_argument(
         "--cpu", action="store_true", help="CPU smoke run (forces w4 kernel)"
     )
+    ap.add_argument(
+        "--metrics-out",
+        default=None,
+        help="write the in-process metrics dump (utils/metrics.py) here — "
+        "the spans recorded by the e2e rows, committable next to the table",
+    )
     args = ap.parse_args()
 
     import jax
@@ -154,6 +160,12 @@ def main() -> None:
     print(f"# batch={n} chunk={c} chunks={per_chunk} kernel={args.kernel}")
     for r in rows:
         print(r)
+
+    if args.metrics_out:
+        from hotstuff_tpu.utils import metrics
+
+        metrics.write_json(args.metrics_out)
+        print(f"# metrics dump -> {args.metrics_out}")
 
 
 if __name__ == "__main__":
