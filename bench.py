@@ -162,6 +162,47 @@ def _qc_batch(committee: int, total: int, seed: int = 7):
     return msgs, batch_pks, sigs, q, n_qc
 
 
+def bench_committee_cache(
+    mode: str, kernel: str, chunk: int, committee: int, total: int, iters: int
+) -> float:
+    """A/B leg of the --committee-cache flag: a QC-shaped workload (64-node
+    committee by default) through the committee-resident path (`on`: keys
+    registered once, lanes gather device-resident window tables by index)
+    or the generic kernel (`off`: per-batch decompression + table build).
+    Run once with each mode and `--metrics-out`, then diff the dumps with
+    tools/metrics_report.py. The zero-rebuild evidence is the counter
+    DELTA across the timed loop, printed to stderr below (the process-
+    global verifier.decompressions/table_builds totals also include the
+    generic device/e2e benches that ran earlier in this process)."""
+    from hotstuff_tpu.ops import ed25519 as ed
+    from hotstuff_tpu.utils import metrics
+
+    msgs, pks, sigs, _q, _n_qc = _qc_batch(committee, total)
+    verifier = ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=chunk)
+    if mode == "on":
+        table = verifier.set_committee(sorted(set(pks)))
+        idx = [table.index[k] for k in pks]
+        run = lambda: verifier.verify_batch_mask_committee(msgs, idx, sigs)
+    else:
+        run = lambda: verifier.verify_batch_mask(msgs, pks, sigs)
+    if not run().all():  # compile + correctness gate
+        raise RuntimeError("committee benchmark batch must fully verify")
+    builds = metrics.counter("verifier.table_builds")
+    decomp = metrics.counter("verifier.decompressions")
+    b0, d0 = builds.value, decomp.value
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        run()
+    dt = time.perf_counter() - t0
+    print(
+        f"# committee-cache={mode}: {iters} x {len(msgs)} sigs -> "
+        f"table_builds +{builds.value - b0}, "
+        f"decompressions +{decomp.value - d0}",
+        file=sys.stderr,
+    )
+    return len(msgs) * iters / dt
+
+
 def bench_committee_scale(
     kernel: str, chunk: int, cpu_budget: float, total: int, iters: int
 ) -> None:
@@ -271,6 +312,18 @@ def main() -> None:
         "the committed artifact next to each BENCH_rN.json",
     )
     ap.add_argument(
+        "--committee-cache",
+        choices=["on", "off"],
+        default=None,
+        help="A/B the committee-resident verification path on a QC-shaped "
+        "64-node-committee workload: 'on' registers the keys once and "
+        "rides the committee kernel (the per-loop table_builds/"
+        "decompressions DELTA printed to stderr is zero), 'off' uses the "
+        "generic kernel. Adds committee_value/committee_cache to the "
+        "JSON line; diff two --metrics-out dumps with "
+        "tools/metrics_report.py for the full before/after table",
+    )
+    ap.add_argument(
         "--committee-scale",
         action="store_true",
         help="print the votes/sec vs committee-size table instead of the "
@@ -358,6 +411,19 @@ def main() -> None:
             msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters,
             mesh=args.mesh,
         )
+        committee_rate = None
+        if args.committee_cache is not None:
+            # the committee path always rides the w4 kernel (no pallas
+            # committee variant); 'off' measures what production otherwise
+            # uses, i.e. the generic kernel of --kernel
+            committee_rate = bench_committee_cache(
+                args.committee_cache,
+                "w4" if args.committee_cache == "on" else args.kernel,
+                args.chunk,
+                64,
+                args.batch,
+                args.e2e_iters,
+            )
     except Exception as e:
         # An unusable measurement environment (e.g. missing host crypto
         # deps) must still produce a parseable JSON line and rc 0. Populate
@@ -399,6 +465,9 @@ def main() -> None:
         "cpu_multicore": round(cpu_multi, 1),
         "backend": "cpu-fallback" if cpu_fallback else jax.default_backend(),
     }
+    if committee_rate is not None:
+        out["committee_cache"] = args.committee_cache
+        out["committee_value"] = round(committee_rate, 1)
     if relay_error is not None:
         out["error"] = relay_error
     _emit(out, args.metrics_out)

@@ -82,10 +82,20 @@ class TCMaker:
 
 
 class Aggregator:
-    def __init__(self, committee: Committee) -> None:
+    def __init__(self, committee: Committee, verification_service=None) -> None:
         self.committee = committee
         self.votes_aggregators: dict[tuple[Round, Digest], QCMaker] = {}
         self.timeouts_aggregators: dict[Round, TCMaker] = {}
+        # Votes/timeouts reaching the aggregator were already verified by
+        # the core; seeding their triples into the service's dedup cache
+        # means the QC/TC assembled from them re-verifies ZERO signatures
+        # (each signature is otherwise checked 2-3x over its lifetime).
+        self.verification_service = verification_service
+
+    def _seed(self, digest: Digest, author: PublicKey, sig: Signature) -> None:
+        svc = self.verification_service
+        if svc is not None and hasattr(svc, "seed_verified"):
+            svc.seed_verified(digest.data, author, sig)
 
     def add_vote(self, vote: Vote) -> QC | None:
         """May raise ConsensusError on Byzantine input (duplicate author).
@@ -94,11 +104,17 @@ class Aggregator:
         advance."""
         key = (vote.round, vote.hash)
         maker = self.votes_aggregators.setdefault(key, QCMaker())
-        return maker.append(vote, self.committee)
+        qc = maker.append(vote, self.committee)
+        self._seed(vote.signed_digest(), vote.author, vote.signature)
+        return qc
 
     def add_timeout(self, timeout: Timeout) -> TC | None:
         maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
-        return maker.append(timeout, self.committee)
+        tc = maker.append(timeout, self.committee)
+        self._seed(
+            timeout.signed_digest(), timeout.author, timeout.signature
+        )
+        return tc
 
     def cleanup(self, round_: Round) -> None:
         self.votes_aggregators = {

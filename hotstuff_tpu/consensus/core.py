@@ -109,7 +109,9 @@ class Core:
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
         self.high_qc: QC = QC.genesis()
-        self.aggregator = Aggregator(committee)
+        # The aggregator seeds verified vote/timeout signatures into the
+        # service's dedup cache, so assembled QCs/TCs short-circuit.
+        self.aggregator = Aggregator(committee, self.verification_service)
         self.timer: Timer | None = None  # created inside the running loop
         # Pacemaker backoff state: consecutive local timeouts without an
         # intervening QC-driven round advance (see Parameters.timeout_backoff).

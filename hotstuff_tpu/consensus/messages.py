@@ -107,10 +107,13 @@ class QC:
     async def verify_async(self, committee: Committee, service) -> None:
         """verify() with the signature batch routed through the
         BatchVerificationService (off-loop, coalesced with other pending
-        requests) instead of a synchronous backend call in the actor loop."""
+        requests) instead of a synchronous backend call in the actor loop.
+        Tagged `committee=True`: every vote is signed by a registered
+        validator key, so the batch rides the committee-resident kernel
+        (and dedup-cached votes skip the backend entirely)."""
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
-        mask = await service.verify_group(msgs, pairs, urgent=True)
+        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
         ensure(all(mask), InvalidSignatureError("QC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
@@ -163,7 +166,7 @@ class TC:
     async def verify_async(self, committee: Committee, service) -> None:
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
-        mask = await service.verify_group(msgs, pairs, urgent=True)
+        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
         ensure(all(mask), InvalidSignatureError("TC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
@@ -287,7 +290,7 @@ class Block:
             tc_lo, tc_hi = len(msgs), len(msgs) + len(m)
             msgs += m
             pairs += p
-        mask = await service.verify_group(msgs, pairs, urgent=True)
+        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
         ensure(mask[0], InvalidSignatureError(f"bad block signature B{self.round}"))
         ensure(
             all(mask[qc_lo:qc_hi]),
@@ -356,7 +359,8 @@ class Vote:
     async def verify_async(self, committee: Committee, service) -> None:
         ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = await service.verify(
-            self.signed_digest().data, self.author, self.signature
+            self.signed_digest().data, self.author, self.signature,
+            committee=True,
         )
         ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
 
@@ -413,7 +417,7 @@ class Timeout:
             m, p = self.high_qc.signed_items()
             msgs += m
             pairs += p
-        mask = await service.verify_group(msgs, pairs, urgent=True)
+        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
         ensure(mask[0], InvalidSignatureError(f"bad timeout signature T{self.round}"))
         ensure(
             all(mask[1:]),

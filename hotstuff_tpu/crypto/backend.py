@@ -13,9 +13,7 @@ import abc
 import threading
 from typing import Sequence
 
-from cryptography.exceptions import InvalidSignature
-
-from .primitives import PublicKey, Signature
+from .primitives import InvalidSignature, PublicKey, Signature
 
 
 class CryptoBackend(abc.ABC):

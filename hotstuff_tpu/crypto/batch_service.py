@@ -28,13 +28,76 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Sequence
 
+from ..utils import metrics
 from .backend import CryptoBackend, get_backend
 from .primitives import PublicKey, Signature
 
 log = logging.getLogger("hotstuff.crypto")
+
+_M_DEDUP_HITS = metrics.counter("verifier.dedup_hits")
+_M_DEDUP_MISSES = metrics.counter("verifier.dedup_misses")
+_M_DEDUP_INSERTS = metrics.counter("verifier.dedup_inserts")
+_M_DEDUP_EVICTIONS = metrics.counter("verifier.dedup_evictions")
+
+
+class VerifiedSigCache:
+    """Bounded LRU of (message, pk, sig) triples that VERIFIED.
+
+    Every vote signature is checked 2-3x over its lifetime: once on vote
+    arrival, again inside every QC that carries it (`QC.verify`), and again
+    when that QC rides a Block/Timeout. A hit here short-circuits the
+    backend call entirely. Only successes are cached (a miss proves
+    nothing), and the triple is the full (message, key, signature) — a
+    forged signature over the same digest can never alias a cached entry.
+
+    Thread-safe: the consensus event loop seeds it while backend dispatch
+    worker threads look entries up.
+    """
+
+    __slots__ = ("maxsize", "_entries", "_lock")
+
+    def __init__(self, maxsize: int = 65536) -> None:
+        if maxsize <= 0:
+            raise ValueError("dedup cache needs maxsize >= 1")
+        self.maxsize = maxsize
+        self._entries: OrderedDict[tuple[bytes, bytes, bytes], None] = (
+            OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def hit(self, message: bytes, key: PublicKey, sig: Signature) -> bool:
+        """True iff this exact triple previously verified (refreshes LRU
+        recency); counts into verifier.dedup_hits/misses."""
+        k = (message, key.data, sig.data)
+        with self._lock:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                _M_DEDUP_HITS.inc()
+                return True
+        _M_DEDUP_MISSES.inc()
+        return False
+
+    def add(self, message: bytes, key: PublicKey, sig: Signature) -> None:
+        """Record a VERIFIED triple; evicts least-recently-used past
+        maxsize (memory stays bounded at ~128 B/entry)."""
+        k = (message, key.data, sig.data)
+        with self._lock:
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                return
+            self._entries[k] = None
+            _M_DEDUP_INSERTS.inc()
+            while len(self._entries) > self.maxsize:
+                self._entries.popitem(last=False)
+                _M_DEDUP_EVICTIONS.inc()
 
 
 @dataclass
@@ -43,6 +106,12 @@ class _Group:
     keys: list[PublicKey]
     signatures: list[Signature]
     urgent: bool
+    committee: bool = False
+    # dedup=False opts the group out of the verified-signature cache: the
+    # mempool's SYNTHETIC workload draws cyclically from a fixed pool of
+    # pre-signed triples, and caching those would make the benchmark
+    # measure the cache instead of the backend.
+    dedup: bool = True
     future: asyncio.Future = field(default_factory=lambda: asyncio.get_running_loop().create_future())
 
     def __len__(self) -> int:
@@ -56,10 +125,16 @@ class BatchVerificationService:
         max_batch: int = 8192,
         max_delay: float = 0.002,
         max_concurrent_dispatches: int = 4,
+        dedup_cache_size: int = 65536,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
         self.max_delay = max_delay
+        # Verified-signature dedup: set dedup_cache_size=0 to disable
+        # (the bench A/B switch and the uncached-baseline tests).
+        self.dedup: VerifiedSigCache | None = (
+            VerifiedSigCache(dedup_cache_size) if dedup_cache_size else None
+        )
         self._queue: asyncio.Queue[_Group] = asyncio.Queue()
         self._task: asyncio.Task | None = None
         # Flushes dispatch CONCURRENTLY (bounded): an urgent 3-signature QC
@@ -93,10 +168,16 @@ class BatchVerificationService:
         messages: Sequence[bytes],
         pairs: Sequence[tuple[PublicKey, Signature]],
         urgent: bool = False,
+        committee: bool = False,
+        dedup: bool = True,
     ) -> list[bool]:
         """Submit a correlated group (e.g. one QC's votes or one synthetic
         payload batch); resolves to the per-item validity mask once the
-        group's flush completes."""
+        group's flush completes. `committee=True` tags the group as signed
+        by registered validator keys, routing it to the backend's
+        committee-resident kernel when available; `dedup=False` bypasses
+        the verified-signature cache (synthetic benchmark load, where
+        repeats are intentional and must pay full verification)."""
         if not messages:
             return []
         self._ensure_task()
@@ -105,6 +186,8 @@ class BatchVerificationService:
             [pk for pk, _ in pairs],
             [sig for _, sig in pairs],
             urgent,
+            committee,
+            dedup,
         )
         await self._queue.put(group)
         return await group.future
@@ -115,10 +198,22 @@ class BatchVerificationService:
         key: PublicKey,
         signature: Signature,
         urgent: bool = True,
+        committee: bool = False,
     ) -> bool:
         """Await a single verification (batched under the hood)."""
-        mask = await self.verify_group([message], [(key, signature)], urgent)
+        mask = await self.verify_group(
+            [message], [(key, signature)], urgent, committee
+        )
         return mask[0]
+
+    def seed_verified(
+        self, message: bytes, key: PublicKey, signature: Signature
+    ) -> None:
+        """Record an ALREADY-VERIFIED triple into the dedup cache (the
+        aggregator seeds vote/timeout signatures on arrival, so the QC/TC
+        assembled from them re-verifies zero signatures here)."""
+        if self.dedup is not None:
+            self.dedup.add(message, key, signature)
 
     # -- flush loop ----------------------------------------------------------
 
@@ -183,15 +278,50 @@ class BatchVerificationService:
             keys = [k for g in groups for k in g.keys]
             sigs = [s for g in groups for s in g.signatures]
             backend = self.backend
-            try:
-                mask = await asyncio.to_thread(
-                    backend.verify_batch_mask, msgs, keys, sigs
-                )
-            except Exception as exc:  # backend failure must not hang callers
-                for g in groups:
-                    if not g.future.done():
-                        g.future.set_exception(exc)
-                return
+
+            # Verified-signature dedup: triples the aggregator (or an
+            # earlier flush) already validated resolve True without
+            # touching the backend; only misses dispatch. Per-item
+            # eligibility: a flush may mix dedup-opted-out synthetic
+            # groups with consensus traffic. The scan (and the index-
+            # gather re-copy) is skipped entirely when no group opted in
+            # or nothing hit — the synthetic throughput path pays zero.
+            cache = self.dedup if any(g.dedup for g in groups) else None
+            mask = [False] * len(msgs)
+            miss = range(len(msgs))
+            dedupable = None
+            if cache is not None:
+                dedupable = [g.dedup for g in groups for _ in range(len(g))]
+                miss = []
+                for i, (m, k, s) in enumerate(zip(msgs, keys, sigs)):
+                    if dedupable[i] and cache.hit(m, k, s):
+                        mask[i] = True
+                    else:
+                        miss.append(i)
+            if miss:
+                full = len(miss) == len(msgs)
+                kwargs = {}
+                if all(g.committee for g in groups) and getattr(
+                    backend, "supports_committee_routing", False
+                ):
+                    kwargs["committee"] = True
+                try:
+                    sub = await asyncio.to_thread(
+                        backend.verify_batch_mask,
+                        msgs if full else [msgs[i] for i in miss],
+                        keys if full else [keys[i] for i in miss],
+                        sigs if full else [sigs[i] for i in miss],
+                        **kwargs,
+                    )
+                except Exception as exc:  # backend failure must not hang callers
+                    for g in groups:
+                        if not g.future.done():
+                            g.future.set_exception(exc)
+                    return
+                for i, ok in zip(miss, sub):
+                    mask[i] = bool(ok)
+                    if ok and cache is not None and dedupable[i]:
+                        cache.add(msgs[i], keys[i], sigs[i])
             self.stats["flushes"] += 1
             self.stats["size_flushes"] += total >= self.max_batch
             self.stats["urgent_flushes"] += urgent

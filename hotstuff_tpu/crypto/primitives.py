@@ -20,11 +20,36 @@ import os
 from dataclasses import dataclass
 from typing import Iterable, Protocol, Sequence
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # host OpenSSL wheel absent: value types stay usable
+    HAVE_CRYPTOGRAPHY = False
+    Ed25519PrivateKey = Ed25519PublicKey = None
+
+    class InvalidSignature(Exception):
+        """Stand-in for cryptography.exceptions.InvalidSignature."""
+
+
+_MISSING_CRYPTOGRAPHY_MSG = (
+    "the 'cryptography' package is not installed on this host; "
+    "host-side ed25519 signing/verification (SecretKey.to_crypto, "
+    "Signature.new/verify, CpuBackend) is unavailable. Install it with "
+    "`pip install cryptography`, or route batch verification through a "
+    "backend that does not need host OpenSSL (e.g. --crypto tpu|remote)."
 )
+
+
+def require_cryptography() -> None:
+    """Raise a clear ImportError when host ed25519 ops are requested on a
+    host without the `cryptography` wheel (tests importorskip on this)."""
+    if not HAVE_CRYPTOGRAPHY:
+        raise ImportError(_MISSING_CRYPTOGRAPHY_MSG)
 
 
 def sha512_32(data: bytes) -> bytes:
@@ -103,6 +128,7 @@ class PublicKey:
         return self.data < other.data
 
     def to_crypto(self) -> Ed25519PublicKey:
+        require_cryptography()
         return Ed25519PublicKey.from_public_bytes(self.data)
 
 
@@ -129,6 +155,7 @@ class SecretKey:
         return SecretKey(base64.standard_b64decode(s))
 
     def to_crypto(self) -> Ed25519PrivateKey:
+        require_cryptography()
         return Ed25519PrivateKey.from_private_bytes(bytes(self._seed))
 
     def __del__(self) -> None:

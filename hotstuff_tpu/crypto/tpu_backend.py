@@ -9,16 +9,25 @@ batch workload mempool/src/core.rs:135-148) to the JAX ed25519 kernel
 Small batches fall back to the host CPU: the TPU wins only past a crossover
 size (dispatch + transfer amortisation — SURVEY.md §7 "hard parts" item 3).
 The crossover is configurable and can be measured with bench.py.
+
+`register_committee()` installs the validator keys as device-resident
+precompute (ops.ed25519.CommitteeTable); batches tagged as committee
+traffic whose keys all resolve then ride the committee kernel — no
+per-batch key decompression or window-table builds. Untagged batches
+(mempool synthetic load, client transactions) keep the generic path.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Sequence
 
 from ..utils import metrics
 from .backend import CpuBackend, CryptoBackend
 from .primitives import PublicKey, Signature
+
+log = logging.getLogger("hotstuff.crypto")
 
 # Mirrors the instance-local `stats` dict into the process-global metrics
 # registry so backend routing shows up in METRICS snapshots and dumps.
@@ -27,10 +36,14 @@ _M_TPU_SIGS = metrics.counter("crypto.tpu_sigs")
 _M_CPU_BATCHES = metrics.counter("crypto.cpu_batches")
 _M_CPU_SIGS = metrics.counter("crypto.cpu_sigs")
 _M_BATCH_SIZE = metrics.histogram("crypto.batch_size", metrics.SIZE_BUCKETS)
+_M_CROSSOVER_FALLBACKS = metrics.counter("verifier.crossover_fallbacks")
+_M_COMMITTEE_MISSES = metrics.counter("verifier.committee_misses")
 
 
 class TpuBackend(CryptoBackend):
     name = "tpu"
+    # BatchVerificationService probes this to tag committee flushes.
+    supports_committee_routing = True
 
     def __init__(
         self,
@@ -40,6 +53,7 @@ class TpuBackend(CryptoBackend):
         mesh=None,
         sharded: bool = False,
         chunk: int | None = None,
+        committee_crossover: int | None = None,
     ):
         # import lazily so CPU-only processes never touch jax
         from ..ops import enable_persistent_cache
@@ -75,8 +89,80 @@ class TpuBackend(CryptoBackend):
             )
         self._cpu = CpuBackend()
         self.crossover = crossover
+        # The committee kernel skips per-batch decompression + window-table
+        # builds and ships 96 B + a 4 B index (vs 128 B) per signature, so
+        # its device break-even sits well below the generic crossover.
+        # Default crossover/4 so quorum-sized QC/TC batches (2f+1 votes)
+        # actually ride the device-resident tables instead of falling to
+        # the host CPU; tune with bench.py --committee-cache.
+        self.committee_crossover = (
+            committee_crossover
+            if committee_crossover is not None
+            else max(1, crossover // 4)
+        )
         self._lock = threading.Lock()
         self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
+
+    # -- committee registration ---------------------------------------------
+
+    def register_committee(
+        self, keys: Sequence[PublicKey | bytes], warmup: bool = False
+    ) -> int:
+        """Install the committee keys as device-resident precompute.
+
+        Idempotent for an identical key sequence; a CHANGED key set (epoch
+        reconfiguration) invalidates and rebuilds the table. With `warmup`,
+        force-compiles the committee kernel at every bucket width the
+        dispatcher uses (same rationale as `warmup()`). Returns the
+        committee size."""
+        raw = [k.data if isinstance(k, PublicKey) else bytes(k) for k in keys]
+        if not getattr(self._verifier, "supports_committee", False):
+            log.warning(
+                "committee registration skipped: %s has no committee path",
+                type(self._verifier).__name__,
+            )
+            return 0
+        table = self._verifier.set_committee(raw)
+        log.info(
+            "registered %d-key committee for device-resident verification",
+            table.size,
+        )
+        if warmup:
+            self._warmup_committee()
+        return table.size
+
+    def _warmup_widths(self) -> list[int]:
+        """Every bucket width the verifier dispatches at runtime — shared
+        by warmup() and _warmup_committee() so the two kernel families are
+        compiled at exactly the same shapes."""
+        v = self._verifier
+        widths, w = [], v.min_bucket
+        top = min(v.chunk, v.max_bucket) if hasattr(v, "chunk") else v.max_bucket
+        while w < top:
+            widths.append(w)
+            w *= 2
+        # The largest shape actually dispatched for a full chunk (bucket
+        # rounding may exceed `top` when min_bucket isn't a power of two).
+        widths.append(v._bucket(top))
+        return widths
+
+    def _warmup_committee(self) -> None:
+        """Compile the committee kernel at every dispatch bucket width
+        (junk wire bytes; shapes are all that matter — see `warmup()`)."""
+        import os
+
+        v = self._verifier
+        widths = self._warmup_widths()
+        for width in widths:
+            v.verify_batch_mask_committee(
+                [os.urandom(32)] * width, [0] * width, [os.urandom(64)] * width
+            )
+        # host-hash variant (the device-hash failure latch's fallback)
+        v.verify_batch_mask_committee(
+            [os.urandom(33)] * widths[-1],
+            [0] * widths[-1],
+            [os.urandom(64)] * widths[-1],
+        )
 
     def warmup(self) -> float:
         """Force-compile every device bucket shape the verifier dispatches at
@@ -98,14 +184,7 @@ class TpuBackend(CryptoBackend):
 
         t0 = time.perf_counter()
         v = self._verifier
-        widths, w = [], v.min_bucket
-        top = min(v.chunk, v.max_bucket) if hasattr(v, "chunk") else v.max_bucket
-        while w < top:
-            widths.append(w)
-            w *= 2
-        # The largest shape actually dispatched for a full chunk (bucket
-        # rounding may exceed `top` when min_bucket isn't a power of two).
-        widths.append(v._bucket(top))
+        widths = self._warmup_widths()
         for width in widths:
             junk_m = [os.urandom(32)] * width
             junk_k = [os.urandom(32)] * width
@@ -123,26 +202,77 @@ class TpuBackend(CryptoBackend):
         messages: Sequence[bytes],
         keys: Sequence[PublicKey],
         signatures: Sequence[Signature],
+        committee: bool = False,
     ) -> list[bool]:
+        """`committee=True` marks the batch as consensus traffic signed by
+        registered validator keys: indices are resolved against the
+        registered table, the lower `committee_crossover` governs the CPU
+        fallback, and the batch rides the committee kernel. Batches with
+        any unregistered key (or no registration) fall back to the generic
+        path — correctness never depends on the tag."""
         n = len(messages)
         if n == 0:
             return []
         _M_BATCH_SIZE.record(n)
-        if n < self.crossover:
+        # Resolve committee routing BEFORE the crossover decision: the
+        # committee kernel's cheaper per-batch cost earns it a lower
+        # CPU/device break-even than the generic path.
+        resolved = self._resolve_committee(keys) if committee else None
+        threshold = (
+            self.committee_crossover if resolved is not None else self.crossover
+        )
+        if n < threshold:
             with self._lock:
                 self.stats["cpu_batches"] += 1
                 self.stats["cpu_sigs"] += n
             _M_CPU_BATCHES.inc()
             _M_CPU_SIGS.inc(n)
+            _M_CROSSOVER_FALLBACKS.inc()
+            # Log once per decade of fallback count (1st, 10th, 100th, ...)
+            # so bench runs show how often the TPU path is bypassed without
+            # flooding the log at consensus rates.
+            count = _M_CROSSOVER_FALLBACKS.value
+            if count >= 1 and count == 10 ** (len(str(count)) - 1):
+                log.info(
+                    "sub-crossover fallback #%d: batch of %d < crossover %d "
+                    "verified on host CPU",
+                    count,
+                    n,
+                    threshold,
+                )
             return self._cpu.verify_batch_mask(messages, keys, signatures)
         with self._lock:
             self.stats["tpu_batches"] += 1
             self.stats["tpu_sigs"] += n
         _M_TPU_BATCHES.inc()
         _M_TPU_SIGS.inc(n)
+        if resolved is not None:
+            indices, table = resolved
+            # the table is PINNED through the dispatch: a concurrent
+            # re-registration must not swap it under these indices
+            mask = self._verifier.verify_batch_mask_committee(
+                list(messages),
+                indices,
+                [s.data for s in signatures],
+                table=table,
+            )
+            return mask.tolist()
         mask = self._verifier.verify_batch_mask(
             list(messages),
             [k.data for k in keys],
             [s.data for s in signatures],
         )
         return mask.tolist()
+
+    def _resolve_committee(self, keys: Sequence[PublicKey]):
+        """Map keys to validator indices against ONE table snapshot;
+        returns (indices, table), or None when unroutable (no
+        registration, or any key outside the registered set)."""
+        table = getattr(self._verifier, "committee", None)
+        if table is None:
+            return None
+        try:
+            return [table.index[k.data] for k in keys], table
+        except KeyError:
+            _M_COMMITTEE_MISSES.inc()
+            return None

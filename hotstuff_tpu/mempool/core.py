@@ -188,8 +188,11 @@ class Core:
         await self._spawn_verification(self._run_synthetic, msgs, pairs)
 
     async def _run_synthetic(self, msgs, pairs) -> None:
+        # dedup=False: the pool cycles a fixed set of pre-signed triples;
+        # the verified-signature cache would otherwise absorb every repeat
+        # and the measured rate would be the cache's, not the backend's.
         mask = await self.verification_service.verify_group(
-            msgs, pairs, urgent=False
+            msgs, pairs, urgent=False, dedup=False
         )
         if not all(mask):
             log.error("synthetic batch verification failed (backend bug?)")

@@ -31,6 +31,7 @@ def _cmd_keys(args) -> None:
 async def _run_node(args) -> None:
     from .node import Node
 
+    backend = None
     if args.crypto != "cpu":
         from ..crypto.backend import make_backend, set_backend
 
@@ -50,6 +51,14 @@ async def _run_node(args) -> None:
 
             warmup_backend(backend)
     node = Node(args.committee, args.keys, args.store, args.parameters)
+    # Committee registration at startup: validator keys become device-
+    # resident verification precompute (decompression + window tables paid
+    # once, not per batch), with the committee kernel compiled before the
+    # node joins consensus. boot() re-asserts the registration (a no-op
+    # for an unchanged key set); re-run node.register_committee on epoch
+    # reconfiguration — a changed key set rebuilds the table.
+    if backend is not None:
+        node.register_committee(warmup=not args.no_warmup)
     node.boot()
     await node.analyze_block()
 

@@ -40,6 +40,7 @@ class Node:
     def boot(self) -> None:
         """Must run inside an event loop (actors spawn on construction)."""
         name = self.secret.name
+        self.register_committee()
         store = Store(self.store_path)
         signature_service = SignatureService(self.secret.secret)
         # One verification service per node: consensus QC/TC/vote checks and
@@ -74,6 +75,21 @@ class Node:
             verification_service=verification_service,
         )
         log.info("Node %s successfully booted", name.short())
+
+    def register_committee(self, warmup: bool = False) -> None:
+        """Install the consensus committee's validator keys as device-
+        resident verification precompute on the active crypto backend
+        (TpuBackend.register_committee). Idempotent; call again after an
+        epoch reconfiguration — a changed key set rebuilds the table.
+        With `warmup`, the committee kernel is compiled at every dispatch
+        bucket width before returning (do this before joining consensus)."""
+        from ..crypto import get_backend
+
+        backend = get_backend()
+        if hasattr(backend, "register_committee"):
+            backend.register_committee(
+                self.committee.consensus.sorted_keys(), warmup=warmup
+            )
 
     async def analyze_block(self) -> None:
         """Application layer: drain committed blocks (node/src/node.rs:95-99)."""

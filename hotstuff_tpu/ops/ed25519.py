@@ -62,6 +62,17 @@ _M_SIGS = metrics.counter("verifier.sigs")
 _M_BATCHES = metrics.counter("verifier.batches")
 _M_CHUNKS = metrics.counter("verifier.chunks")
 _M_DH_FALLBACKS = metrics.counter("verifier.device_hash_fallbacks")
+# Committee-residency accounting: the generic kernels re-decompress every
+# lane's public key and rebuild its 16-entry -A window table per chunk
+# (decompressions / table_builds); the committee path gathers precomputed
+# tables by validator index and increments NEITHER — the acceptance check
+# for steady-state zero-rebuild batches.
+_M_DECOMPRESSIONS = metrics.counter("verifier.decompressions")
+_M_TABLE_BUILDS = metrics.counter("verifier.table_builds")
+_M_COMMITTEE_BATCHES = metrics.counter("verifier.committee_batches")
+_M_COMMITTEE_SIGS = metrics.counter("verifier.committee_sigs")
+_M_COMMITTEE_REGS = metrics.counter("verifier.committee_registrations")
+_M_COMMITTEE_SIZE = metrics.gauge("verifier.committee_size")
 
 P = f.P
 L_ORDER = 2**252 + 27742317777372353535851937790883648493
@@ -122,8 +133,10 @@ def point_dbl(p: Point, with_t: bool = True) -> Point:
     return f.mul(xp, tp), f.mul(yp, zp), f.mul(zp, tp), t_out
 
 
-def point_madd(p: Point, q_ypx, q_ymx, q_xy2d) -> Point:
-    """Unified mixed addition (madd-2008-hwcd-3): P + affine precomp Q."""
+def point_madd(p: Point, q_ypx, q_ymx, q_xy2d, with_t: bool = True) -> Point:
+    """Unified mixed addition (madd-2008-hwcd-3): P + affine precomp Q.
+    `with_t=False` skips producing T (valid when the consumer is a doubling
+    or the final compress, neither of which reads it)."""
     X1, Y1, Z1, T1 = p
     a = f.mul(f.add(Y1, X1), q_ypx)
     b = f.mul(f.sub(Y1, X1), q_ymx)
@@ -133,7 +146,8 @@ def point_madd(p: Point, q_ypx, q_ymx, q_xy2d) -> Point:
     y3 = f.add(a, b)
     z3 = f.add(d2z, c)
     t3 = f.sub(d2z, c)
-    return f.mul(x3, t3), f.mul(y3, z3), f.mul(z3, t3), f.mul(x3, y3)
+    t_out = f.mul(x3, y3) if with_t else jnp.zeros_like(x3)
+    return f.mul(x3, t3), f.mul(y3, z3), f.mul(z3, t3), t_out
 
 
 def _select_point(mask: jnp.ndarray, a: Point, b: Point) -> Point:
@@ -286,6 +300,184 @@ def _verify_kernel_w4(a_y, a_sign, r_enc, s_digits, h_digits):
     return valid & jnp.all(enc == r_enc, axis=0)
 
 
+# --- committee-resident key precomputation --------------------------------
+#
+# The protocol's hot path verifies signatures from a FIXED set of <= ~100
+# validator keys, yet the generic kernel re-decompresses each lane's key
+# (sqrt addition chain, ~250 field ops) and rebuilds its 16-entry -A window
+# table (14 cached adds) on device EVERY batch. A CommitteeTable pays that
+# once per committee on the host with exact integer math and keeps the
+# result device-resident; committee lanes then GATHER their table by
+# validator index — zero per-batch decompressions or table builds, the
+# per-verification amortization lever of "Performance of EdDSA and BLS
+# Signatures in Committee-Based Consensus" (PAPERS.md).
+#
+# Host precompute yields AFFINE table entries (canonical limbs <= 255), so
+# the per-item adds become mixed additions (madd-2008-hwcd-3) — one field
+# mul per add cheaper than the generic path's cached adds, on top of the
+# skipped decompress/build.
+
+
+def _decompress_int(key: bytes) -> tuple[int, int] | None:
+    """Exact host decompression of a 32-byte compressed point.
+
+    Matches the device `decompress` semantics bit for bit: y is reduced
+    mod p (non-canonical encodings are NOT rejected, mirroring the field-
+    element decode of the device limbs and of ed25519_dalek), x = 0 absorbs
+    either sign, and None is returned only when no square root exists."""
+    enc = int.from_bytes(key, "little")
+    sign = enc >> 255
+    y = (enc & ((1 << 255) - 1)) % P
+    u = (y * y - 1) % P
+    v = (D_INT * y * y + 1) % P
+    x2 = u * pow(v, P - 2, P) % P
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * SQRTM1_INT % P
+    if (x * x - x2) % P != 0:
+        return None
+    if x % 2 != sign:
+        x = (P - x) % P
+    return x, y
+
+
+class CommitteeTable:
+    """Device-resident per-validator -A window tables, built once per
+    committee.
+
+    Layout (N = committee size):
+      ta_ypx / ta_ymx / ta_xy2d : (16, 32, N) f32 — affine precomp of
+          k*(-A_i) for k = 0..15 (row 0 is the madd identity (1, 1, 0))
+      valid   : (N,) bool — False for keys with no valid decompression
+          (their lanes always fail, matching the generic kernel)
+      keys_u8 : (32, N) u8 — raw key bytes, gathered on device by the
+          device-hash kernel for h = SHA-512(R||A||M)
+
+    `index` maps raw 32-byte key -> validator index for host-side routing.
+    """
+
+    def __init__(self, keys: Sequence[bytes]) -> None:
+        import jax as _jax
+
+        keys = [bytes(k) for k in keys]
+        if not keys:
+            raise ValueError("committee must have at least one key")
+        self.keys = keys
+        self.index: dict[bytes, int] = {}
+        for i, k in enumerate(keys):
+            self.index.setdefault(k, i)
+        n = len(keys)
+        ypx = np.zeros((16, f.NLIMB, n), np.float32)
+        ymx = np.zeros_like(ypx)
+        xy2d = np.zeros_like(ypx)
+        valid = np.zeros(n, bool)
+        keys_u8 = np.zeros((32, n), np.uint8)
+        for i, kb in enumerate(keys):
+            keys_u8[:, i] = np.frombuffer(kb, np.uint8)
+            ypx[0, 0, i] = 1.0  # madd identity: (ypx, ymx, xy2d) = (1, 1, 0)
+            ymx[0, 0, i] = 1.0
+            pt = _decompress_int(kb)
+            if pt is None:
+                continue
+            valid[i] = True
+            x, y = pt
+            neg = ((P - x) % P, y)
+            cur = (0, 1)
+            for k in range(1, 16):
+                cur = _edwards_add_int(cur, neg)
+                cx, cy = cur
+                ypx[k, :, i] = f.limbs_of_int((cy + cx) % P)[:, 0]
+                ymx[k, :, i] = f.limbs_of_int((cy - cx) % P)[:, 0]
+                xy2d[k, :, i] = f.limbs_of_int(D2_INT * cx * cy % P)[:, 0]
+        self.ta_ypx = _jax.device_put(ypx)
+        self.ta_ymx = _jax.device_put(ymx)
+        self.ta_xy2d = _jax.device_put(xy2d)
+        self.valid = _jax.device_put(valid)
+        self.keys_u8 = _jax.device_put(keys_u8)
+        self.size = n
+
+
+def _verify_kernel_w4_committee(
+    ta_ypx, ta_ymx, ta_xy2d, valid, idx, r_enc, s_digits, h_digits
+):
+    """Committee variant of `_verify_kernel_w4`: lanes gather their -A
+    window table from the device-resident committee precompute by validator
+    index — no decompression, no `_build_neg_a_table`. Affine tables make
+    the per-item adds mixed additions."""
+    g_ypx = jnp.take(ta_ypx, idx, axis=2)
+    g_ymx = jnp.take(ta_ymx, idx, axis=2)
+    g_xy2d = jnp.take(ta_xy2d, idx, axis=2)
+    b_ypx, b_ymx, b_xy2d = BASE_TABLE
+    batch = idx.shape[0]
+    dtype = r_enc.dtype
+
+    def body(g, acc: Point) -> Point:
+        row = NGROUPS - 1 - g
+        for i in range(WINDOW):
+            acc = point_dbl(acc, with_t=i == WINDOW - 1)
+        sd = lax.dynamic_index_in_dim(s_digits, row, 0, keepdims=False)
+        hd = lax.dynamic_index_in_dim(h_digits, row, 0, keepdims=False)
+        s_oh = jax.nn.one_hot(sd.astype(jnp.int32), 16, axis=0, dtype=dtype)
+        h_oh = jax.nn.one_hot(hd.astype(jnp.int32), 16, axis=0, dtype=dtype)
+        acc = point_madd(
+            acc,
+            _lookup_shared(b_ypx, s_oh),
+            _lookup_shared(b_ymx, s_oh),
+            _lookup_shared(b_xy2d, s_oh),
+        )
+        acc = point_madd(
+            acc,
+            _lookup_per_item(g_ypx, h_oh),
+            _lookup_per_item(g_ymx, h_oh),
+            _lookup_per_item(g_xy2d, h_oh),
+            with_t=False,
+        )
+        return acc
+
+    result = lax.fori_loop(0, NGROUPS, body, point_identity(batch))
+    enc = compress(result)
+    return jnp.take(valid, idx) & jnp.all(enc == r_enc, axis=0)
+
+
+def _verify_kernel_w4_committee_packed96(
+    ta_ypx, ta_ymx, ta_xy2d, valid, idx, packed
+):
+    """(96, B) u8 wire rows (R, S, host-computed h) + (B,) i32 indices."""
+    r_b, s_b, h_b = packed[0:32], packed[32:64], packed[64:96]
+    return _verify_kernel_w4_committee(
+        ta_ypx,
+        ta_ymx,
+        ta_xy2d,
+        valid,
+        idx,
+        r_b.astype(jnp.float32),
+        _device_nibbles(s_b),
+        _device_nibbles(h_b),
+    )
+
+
+def _verify_kernel_w4_committee_packed96_dh(
+    ta_ypx, ta_ymx, ta_xy2d, valid, keys_u8, idx, packed
+):
+    """Device-hash committee variant: rows 64-95 carry the 32-byte MESSAGE;
+    the key bytes for h = SHA-512(R||A||M) are gathered on device from the
+    committee-resident `keys_u8`, so the host ships neither keys nor h."""
+    from . import sha512
+
+    r_b, s_b, m_b = packed[0:32], packed[32:64], packed[64:96]
+    a_b = jnp.take(keys_u8, idx, axis=1)
+    return _verify_kernel_w4_committee(
+        ta_ypx,
+        ta_ymx,
+        ta_xy2d,
+        valid,
+        idx,
+        r_b.astype(jnp.float32),
+        _device_nibbles(s_b),
+        sha512.h_digits_on_device(r_b, a_b, m_b),
+    )
+
+
 # --- packed (u8) wire format ----------------------------------------------
 #
 # The f32 kernel arguments are 772 B/signature (a_y, r_enc 128 B each;
@@ -427,6 +619,9 @@ _verify_w4_jit = jax.jit(_verify_kernel_w4)
 _verify_w4p_jit = jax.jit(_verify_kernel_w4_packed)
 _verify_w4p128_jit = jax.jit(_verify_kernel_w4_packed128)
 _verify_w4p128dh_jit = jax.jit(_verify_kernel_w4_packed128_dh)
+_verify_w4c_jit = jax.jit(_verify_kernel_w4_committee)
+_verify_w4c96_jit = jax.jit(_verify_kernel_w4_committee_packed96)
+_verify_w4c96dh_jit = jax.jit(_verify_kernel_w4_committee_packed96_dh)
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +739,48 @@ def prepare_batch_packed_dh(
     return dict(packed=packed, s_ok=_s_canonical_mask(s))
 
 
+def prepare_batch_committee(
+    messages: Sequence[bytes],
+    key_bytes: Sequence[bytes],
+    indices: Sequence[int],
+    signatures: Sequence[bytes],
+) -> dict:
+    """Committee host-hash staging: dict(packed=(96, B) u8, idx=(B,) i32,
+    s_ok=(B,) bool). Rows 0-31 = R, 32-63 = S, 64-95 = h; `key_bytes` are
+    the resolved committee key rows, needed only to compute h on host —
+    they are NOT shipped to the device."""
+    n = len(messages)
+    sig = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
+    r, s = sig[:, :32], sig[:, 32:]
+    a = np.frombuffer(b"".join(key_bytes), np.uint8).reshape(n, 32)
+    s_ok, h_bytes = _stage_scalars(messages, a, r, s)
+    packed = np.ascontiguousarray(np.vstack([r.T, s.T, h_bytes.T]))
+    return dict(packed=packed, idx=np.asarray(indices, np.int32), s_ok=s_ok)
+
+
+def prepare_batch_committee_dh(
+    messages: Sequence[bytes],
+    indices: Sequence[int],
+    signatures: Sequence[bytes],
+) -> dict:
+    """Committee device-hash staging: dict(packed=(96, B) u8, idx, s_ok).
+
+    Rows 64-95 carry the 32-byte MESSAGE; the device gathers the key bytes
+    from the committee-resident table and hashes on device — host staging
+    is byte concatenation plus the vectorized s < L check, and the wire
+    cost drops to 96 B + 4 B index per signature (no key row at all)."""
+    n = len(messages)
+    sig = np.frombuffer(b"".join(signatures), np.uint8).reshape(n, 64)
+    m = np.frombuffer(b"".join(messages), np.uint8).reshape(n, 32)
+    r, s = sig[:, :32], sig[:, 32:]
+    packed = np.ascontiguousarray(np.vstack([r.T, s.T, m.T]))
+    return dict(
+        packed=packed,
+        idx=np.asarray(indices, np.int32),
+        s_ok=_s_canonical_mask(s),
+    )
+
+
 def _stage_scalars(messages, a, r, s) -> tuple[np.ndarray, np.ndarray]:
     """Python staging of the per-item scalar work shared by both wire
     formats: the s<L canonicality mask and h = SHA-512(R||A||M) mod L."""
@@ -626,6 +863,12 @@ class Ed25519TpuVerifier:
     mesh verifier and the legacy bit-ladder kernel).
     """
 
+    # Single-device committee-resident fast path (set_committee /
+    # verify_batch_mask_committee). The mesh subclass disables it: the
+    # committee kernel is not shard_map-wrapped, and the mesh's sharded
+    # device_put cannot place the replicated tables + 1-D index vector.
+    supports_committee = True
+
     def __init__(
         self,
         min_bucket: int = 128,
@@ -651,6 +894,156 @@ class Ed25519TpuVerifier:
         # take down every verification), fall back to host hashing for the
         # life of this verifier.
         self._device_hash_ok = True
+        # Device-resident committee precompute (set_committee). The
+        # committee path always rides the w4 jnp kernel: the pallas ladder
+        # has no committee variant yet, and skipping decompress + table
+        # build dominates the flavour difference at committee batch sizes.
+        self._committee: CommitteeTable | None = None
+
+    # -- committee-resident fast path ---------------------------------------
+
+    @property
+    def committee(self) -> "CommitteeTable | None":
+        return self._committee
+
+    def set_committee(self, keys: Sequence[bytes]) -> CommitteeTable:
+        """Install (or rebuild) the device-resident committee table.
+
+        An identical key sequence is a no-op (same table object); a changed
+        key set INVALIDATES the previous table and rebuilds — the
+        reconfiguration contract. Returns the active table."""
+        if not self.supports_committee:
+            raise NotImplementedError(
+                f"{type(self).__name__} has no committee-resident path"
+            )
+        keys = [bytes(k) for k in keys]
+        if self._committee is not None and self._committee.keys == keys:
+            return self._committee
+        self._committee = CommitteeTable(keys)
+        _M_COMMITTEE_REGS.inc()
+        _M_COMMITTEE_SIZE.set(self._committee.size)
+        return self._committee
+
+    def verify_batch_mask_committee(
+        self,
+        messages: Sequence[bytes],
+        indices: Sequence[int],
+        signatures: Sequence[bytes],
+        table: "CommitteeTable | None" = None,
+    ) -> np.ndarray:
+        """Committee fast path: items carry validator INDICES into the
+        registered table — steady-state batches perform zero on-device
+        decompressions or window-table builds.
+
+        `table` pins the CommitteeTable the indices were resolved against:
+        a concurrent re-registration (epoch reconfiguration) must not swap
+        the table under an in-flight batch, or lanes would gather another
+        validator's precompute. Defaults to the currently registered one.
+        """
+        ct = table or self._committee
+        if ct is None:
+            raise RuntimeError(
+                "no committee registered (call set_committee first)"
+            )
+        n = len(messages)
+        if n == 0:
+            return np.empty(0, bool)
+        _M_BATCHES.inc()
+        _M_SIGS.inc(n)
+        _M_BATCH_SIZE.record(n)
+        _M_COMMITTEE_BATCHES.inc()
+        _M_COMMITTEE_SIGS.inc(n)
+        with metrics.span(_M_E2E):
+            device_hash = self._device_hash_ok and all(
+                len(m) == 32 for m in messages
+            )
+            try:
+                return self._run_committee(
+                    ct, messages, list(indices), signatures, device_hash
+                )
+            except Exception:
+                if not device_hash:
+                    raise
+                log.exception(
+                    "committee device-hash kernel failed; retrying with "
+                    "host hashing"
+                )
+                _M_DH_FALLBACKS.inc()
+                out = self._run_committee(
+                    ct, messages, list(indices), signatures, False
+                )
+                self._device_hash_ok = False
+                return out
+
+    def _run_committee(self, ct, messages, indices, signatures, device_hash: bool):
+        n = len(messages)
+        up = _uploader()
+        futs, oks, spans = [], [], []
+        for lo in range(0, n, self.chunk):
+            hi = min(lo + self.chunk, n)
+            _M_CHUNKS.inc()
+            idx_chunk = indices[lo:hi]
+            with metrics.span(_M_STAGE):
+                if device_hash:
+                    staged = prepare_batch_committee_dh(
+                        messages[lo:hi], idx_chunk, signatures[lo:hi]
+                    )
+                else:
+                    staged = prepare_batch_committee(
+                        messages[lo:hi],
+                        [ct.keys[i] for i in idx_chunk],
+                        idx_chunk,
+                        signatures[lo:hi],
+                    )
+            width = self._bucket(hi - lo)
+            futs.append(
+                up.submit(
+                    self._upload_dispatch_committee,
+                    ct,
+                    _pad(staged["packed"], width),
+                    _pad(staged["idx"], width),
+                    device_hash,
+                )
+            )
+            oks.append(staged["s_ok"])
+            spans.append((lo, hi, width))
+        masks = [fu.result() for fu in futs]
+        out = np.empty(n, bool)
+        with metrics.span(_M_READBACK):
+            full = self._materialize(masks)
+        off = 0
+        for (lo, hi, width), ok in zip(spans, oks):
+            out[lo:hi] = full[off : off + hi - lo] & ok
+            off += width
+        return out
+
+    def _upload_dispatch_committee(
+        self, ct, packed: np.ndarray, idx: np.ndarray, device_hash: bool
+    ):
+        """Uploader-thread leg of the committee path: ship the (96, W) wire
+        array + (W,) index vector, dispatch against the RESIDENT tables of
+        `ct` (pinned by the caller — never re-read from self, a concurrent
+        re-registration must not swap tables under in-flight chunks)."""
+        import jax as _jax
+
+        put = self._put or _jax.device_put
+        with metrics.span(_M_UPLOAD):
+            dev_p = put(packed)
+            dev_i = put(idx)
+        with metrics.span(_M_DISPATCH):
+            if device_hash:
+                return _verify_w4c96dh_jit(
+                    ct.ta_ypx,
+                    ct.ta_ymx,
+                    ct.ta_xy2d,
+                    ct.valid,
+                    ct.keys_u8,
+                    dev_i,
+                    dev_p,
+                )
+            return _verify_w4c96_jit(
+                ct.ta_ypx, ct.ta_ymx, ct.ta_xy2d, ct.valid, dev_i, dev_p
+            )
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -731,6 +1124,11 @@ class Ed25519TpuVerifier:
         for lo in range(0, n, self.chunk):
             hi = min(lo + self.chunk, n)
             _M_CHUNKS.inc()
+            # The generic kernel decompresses every lane's key and rebuilds
+            # its -A window table on device — the per-batch cost the
+            # committee path amortizes away.
+            _M_TABLE_BUILDS.inc()
+            _M_DECOMPRESSIONS.inc(hi - lo)
             with metrics.span(_M_STAGE):
                 staged = stage(
                     messages[lo:hi], keys[lo:hi], signatures[lo:hi]
@@ -763,6 +1161,8 @@ class Ed25519TpuVerifier:
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)
         _M_CHUNKS.inc()
+        _M_TABLE_BUILDS.inc()
+        _M_DECOMPRESSIONS.inc(n)
         with metrics.span(_M_STAGE):
             staged = prepare_batch(
                 messages, keys, signatures, want_bits=self.kernel == "bits"

@@ -197,7 +197,13 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     pipeline of the base class; chunks are device_put with an explicit
     batch-axis NamedSharding so the transfer lands sharded (no device-0
     staging + reshard). `packed=False` restores the f32-argument
-    `sharded_verify_fn` path (used by the legacy bit-ladder kernel)."""
+    `sharded_verify_fn` path (used by the legacy bit-ladder kernel).
+
+    No committee-resident path yet: the committee kernel is not
+    shard_map-wrapped, so TpuBackend.register_committee no-ops on a
+    sharded backend (generic kernels keep serving committee traffic)."""
+
+    supports_committee = False
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)

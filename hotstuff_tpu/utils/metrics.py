@@ -466,6 +466,19 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("verifier.batches", "counter", None),
     ("verifier.chunks", "counter", None),
     ("verifier.device_hash_fallbacks", "counter", None),
+    # committee-resident key precompute + verified-signature dedup
+    ("verifier.decompressions", "counter", None),
+    ("verifier.table_builds", "counter", None),
+    ("verifier.committee_batches", "counter", None),
+    ("verifier.committee_sigs", "counter", None),
+    ("verifier.committee_registrations", "counter", None),
+    ("verifier.committee_misses", "counter", None),
+    ("verifier.committee_size", "gauge", None),
+    ("verifier.crossover_fallbacks", "counter", None),
+    ("verifier.dedup_hits", "counter", None),
+    ("verifier.dedup_misses", "counter", None),
+    ("verifier.dedup_inserts", "counter", None),
+    ("verifier.dedup_evictions", "counter", None),
     ("crypto.tpu_batches", "counter", None),
     ("crypto.tpu_sigs", "counter", None),
     ("crypto.cpu_batches", "counter", None),

@@ -8,6 +8,12 @@ from __future__ import annotations
 import asyncio
 import random
 
+import pytest
+
+# Every fixture here signs with the host OpenSSL wheel; without it the
+# importing test module reports a skip instead of a collection error.
+pytest.importorskip("cryptography")
+
 from hotstuff_tpu.consensus import Block, Committee, Vote, QC
 from hotstuff_tpu.consensus.mempool_driver import (
     MempoolCleanup,

@@ -20,6 +20,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the w4/committee ladder kernels take
+# minutes each to compile on the CPU backend; repeat test runs on the same
+# host hit the on-disk cache instead (HOTSTUFF_JAX_CACHE=0 disables).
+from hotstuff_tpu.ops import enable_persistent_cache
+
+enable_persistent_cache()
+
 import asyncio
 
 import pytest

@@ -5,6 +5,8 @@ import random
 
 import pytest
 
+pytest.importorskip("cryptography")
+
 from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
 from hotstuff_tpu.crypto.backend import CpuBackend
 from hotstuff_tpu.crypto.batch_service import BatchVerificationService

@@ -1,0 +1,192 @@
+"""Verified-signature dedup cache (crypto/batch_service.VerifiedSigCache).
+
+The aggregator verifies each vote on arrival; the QC assembled from those
+votes re-verifies the SAME (digest, pk, sig) triples 1-2 more times over
+their lifetime. The dedup cache short-circuits those repeats before the
+backend dispatch. Unit tests here are dependency-free (stub backend, raw
+key bytes — no `cryptography` wheel needed); the consensus-round e2e
+assertion lives in test_dedup_consensus.py.
+"""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.crypto.backend import CryptoBackend
+from hotstuff_tpu.crypto.batch_service import (
+    BatchVerificationService,
+    VerifiedSigCache,
+)
+from hotstuff_tpu.crypto.primitives import PublicKey, Signature
+from hotstuff_tpu.utils import metrics
+
+
+def _triple(i: int):
+    return (
+        bytes([i]) * 32,
+        PublicKey(bytes([i]) * 32),
+        Signature(bytes([i]) * 64),
+    )
+
+
+class _CountingBackend(CryptoBackend):
+    """All-true backend counting every signature it is asked to verify."""
+
+    name = "counting"
+
+    def __init__(self, committee_routing: bool = False):
+        self.verified = 0
+        self.calls: list[int] = []
+        self.committee_tags: list[bool] = []
+        if committee_routing:
+            self.supports_committee_routing = True
+
+    def verify_batch_mask(self, messages, keys, signatures, committee=False):
+        self.verified += len(messages)
+        self.calls.append(len(messages))
+        self.committee_tags.append(committee)
+        return [True] * len(messages)
+
+
+class TestVerifiedSigCache:
+    def test_hit_requires_exact_triple(self):
+        cache = VerifiedSigCache(8)
+        m, pk, sig = _triple(1)
+        cache.add(m, pk, sig)
+        assert cache.hit(m, pk, sig)
+        # a forged signature over the same digest can never alias the entry
+        assert not cache.hit(m, pk, Signature(bytes(64)))
+        assert not cache.hit(bytes(32), pk, sig)
+
+    def test_lru_eviction_bounds_memory(self):
+        ev0 = metrics.counter("verifier.dedup_evictions").value
+        cache = VerifiedSigCache(4)
+        for i in range(10):
+            cache.add(*_triple(i))
+            assert len(cache) <= 4
+        assert metrics.counter("verifier.dedup_evictions").value == ev0 + 6
+        # oldest evicted, newest retained
+        assert not cache.hit(*_triple(0))
+        assert cache.hit(*_triple(9))
+
+    def test_recency_refresh_on_hit(self):
+        cache = VerifiedSigCache(2)
+        cache.add(*_triple(1))
+        cache.add(*_triple(2))
+        assert cache.hit(*_triple(1))  # refresh 1 -> 2 becomes LRU
+        cache.add(*_triple(3))  # evicts 2
+        assert cache.hit(*_triple(1))
+        assert not cache.hit(*_triple(2))
+
+    def test_rejects_zero_size(self):
+        with pytest.raises(ValueError):
+            VerifiedSigCache(0)
+
+
+class TestServiceDedup:
+    def test_repeat_verification_skips_backend(self, run_async):
+        async def body():
+            backend = _CountingBackend()
+            svc = BatchVerificationService(backend, max_delay=0.001)
+            m, pk, sig = _triple(1)
+            assert await svc.verify(m, pk, sig)
+            assert backend.verified == 1
+            # the same triple again: cache hit, no second backend call
+            assert await svc.verify(m, pk, sig)
+            assert backend.verified == 1
+            assert svc.stats["verified"] == 2
+
+        run_async(body())
+
+    def test_seed_verified_short_circuits_first_check(self, run_async):
+        async def body():
+            backend = _CountingBackend()
+            svc = BatchVerificationService(backend, max_delay=0.001)
+            m, pk, sig = _triple(2)
+            svc.seed_verified(m, pk, sig)  # the aggregator's seam
+            assert await svc.verify(m, pk, sig)
+            assert backend.verified == 0, "seeded triple must not dispatch"
+
+        run_async(body())
+
+    def test_dedup_disabled_dispatches_every_time(self, run_async):
+        async def body():
+            backend = _CountingBackend()
+            svc = BatchVerificationService(
+                backend, max_delay=0.001, dedup_cache_size=0
+            )
+            assert svc.dedup is None
+            m, pk, sig = _triple(3)
+            assert await svc.verify(m, pk, sig)
+            assert await svc.verify(m, pk, sig)
+            assert backend.verified == 2
+
+        run_async(body())
+
+    def test_mixed_group_only_misses_dispatch(self, run_async):
+        async def body():
+            backend = _CountingBackend()
+            svc = BatchVerificationService(backend, max_delay=0.001)
+            triples = [_triple(i) for i in range(4)]
+            for m, pk, sig in triples[:2]:
+                svc.seed_verified(m, pk, sig)
+            mask = await svc.verify_group(
+                [m for m, _, _ in triples],
+                [(pk, sig) for _, pk, sig in triples],
+            )
+            assert mask == [True] * 4
+            assert backend.verified == 2, "only the 2 cache misses dispatch"
+
+        run_async(body())
+
+    def test_dedup_opt_out_group_always_dispatches(self, run_async):
+        """Synthetic benchmark groups (dedup=False) must pay full backend
+        verification on every repeat — the cache must neither serve nor
+        learn their triples."""
+
+        async def body():
+            backend = _CountingBackend()
+            svc = BatchVerificationService(backend, max_delay=0.001)
+            m, pk, sig = _triple(9)
+            for _ in range(2):
+                mask = await svc.verify_group(
+                    [m], [(pk, sig)], dedup=False
+                )
+                assert mask == [True]
+            assert backend.verified == 2
+            # and the opted-out triple was never inserted
+            assert not svc.dedup.hit(m, pk, sig)
+
+        run_async(body())
+
+    def test_committee_tag_reaches_backend(self, run_async):
+        async def body():
+            backend = _CountingBackend(committee_routing=True)
+            svc = BatchVerificationService(backend, max_delay=0.001)
+            m, pk, sig = _triple(5)
+            await svc.verify(m, pk, sig, committee=True)
+            m2, pk2, sig2 = _triple(6)
+            await svc.verify(m2, pk2, sig2, committee=False)
+            assert backend.committee_tags == [True, False]
+
+        run_async(body())
+
+    def test_untagged_backend_never_gets_kwarg(self, run_async):
+        """A backend without supports_committee_routing (CpuBackend,
+        RemoteBackend) must be called with the plain 3-arg signature."""
+
+        class StrictBackend(CryptoBackend):
+            name = "strict"
+            verified = 0
+
+            def verify_batch_mask(self, messages, keys, signatures):
+                StrictBackend.verified += len(messages)
+                return [True] * len(messages)
+
+        async def body():
+            svc = BatchVerificationService(StrictBackend(), max_delay=0.001)
+            m, pk, sig = _triple(7)
+            assert await svc.verify(m, pk, sig, committee=True)
+            assert StrictBackend.verified == 1
+
+        run_async(body())

@@ -15,6 +15,9 @@ import sys
 
 import pytest
 
+# the worker subprocesses sign their batch with the host OpenSSL wheel
+pytest.importorskip("cryptography")
+
 WORKER = r"""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"

@@ -22,6 +22,7 @@ RNG = random.Random(11)
 
 
 def _batch(n, msg_len=64):
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )

@@ -115,6 +115,7 @@ def _np_limbs(v: int):
 
 
 def _sign_many(n, msg_len=32):
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )

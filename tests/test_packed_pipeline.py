@@ -17,6 +17,7 @@ from hotstuff_tpu.ops import ed25519 as ed
 
 
 def _signed(n, seed=3, msg_len=32):
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -98,6 +99,7 @@ class TestUrgentBypass:
         """With every dispatch slot held by a slow backend call, an urgent
         group must still dispatch immediately (consensus-critical QC checks
         must not wait out a device round trip)."""
+        pytest.importorskip("cryptography")
         from hotstuff_tpu.crypto import Digest, Signature, generate_keypair
         from hotstuff_tpu.crypto.backend import CpuBackend
         from hotstuff_tpu.crypto.batch_service import BatchVerificationService

@@ -200,6 +200,7 @@ def test_install_command(fake_aws):
 
 
 def test_config_generates_and_uploads(fake_aws):
+    pytest.importorskip("cryptography")  # _config generates real keypairs
     bench = _bench(fake_aws)
     hosts = ["10.0.0.1", "10.0.1.1"]
     key_files = bench._config(hosts, __import__("benchmark.config", fromlist=["NodeParameters"]).NodeParameters({}))
@@ -280,6 +281,7 @@ def test_run_single_tpu_boots_sidecar(fake_aws, monkeypatch):
 
 
 def test_full_sweep_writes_results(fake_aws, monkeypatch, tmp_path):
+    pytest.importorskip("cryptography")  # the sweep generates real keypairs
     from benchmark.aws import remote as remote_mod
 
     monkeypatch.setattr(

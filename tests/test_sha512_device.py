@@ -15,6 +15,14 @@ import jax.numpy as jnp
 from hotstuff_tpu.ops import ed25519 as ed
 from hotstuff_tpu.ops import sha512 as S
 
+
+def _signed_batch(*args, **kwargs):
+    """OpenSSL-signed batch; skips the test when the wheel is absent."""
+    pytest.importorskip("cryptography")
+    from __graft_entry__ import _signed_batch as real
+
+    return real(*args, **kwargs)
+
 RNG = random.Random(17)
 
 
@@ -69,8 +77,6 @@ def test_reduce_mod_l_exact():
 
 
 def test_h_digits_on_device_matches_host_staging():
-    from __graft_entry__ import _signed_batch
-
     msgs, pks, sigs = _signed_batch(32, seed=9)
     host = ed.prepare_batch(msgs, pks, sigs, allow_native=False)
     r = _cols([s[:32] for s in sigs])
@@ -87,8 +93,6 @@ def test_h_digits_on_device_matches_host_staging():
 def test_packed_dh_kernel_matches_packed():
     """The device-hash kernel must agree with the host-hash kernel on good
     AND adversarial items (corrupt signature, corrupt key, zero rows)."""
-    from __graft_entry__ import _signed_batch
-
     msgs, pks, sigs = _signed_batch(8, seed=4)
     sigs[2] = bytes(64)
     pks[5] = bytes(31) + b"\xff"
@@ -117,8 +121,6 @@ def test_s_canonical_mask_vectorized():
 def test_verifier_auto_selects_device_hash():
     """32-byte messages ride the device-hash path; mixed lengths fall back
     to host hashing — both must verify correctly."""
-    from __graft_entry__ import _signed_batch
-
     v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
     msgs, pks, sigs = _signed_batch(6, seed=11)
     sigs[3] = bytes(64)
@@ -135,8 +137,6 @@ def test_verifier_auto_selects_device_hash():
 def test_sharded_device_hash_matches():
     from hotstuff_tpu.parallel import ShardedEd25519Verifier, default_mesh
 
-    from __graft_entry__ import _signed_batch
-
     msgs, pks, sigs = _signed_batch(16, seed=13)
     sigs[9] = sigs[1]
     v = ShardedEd25519Verifier(mesh=default_mesh(4), kernel="w4")
@@ -149,8 +149,6 @@ def test_sharded_device_hash_matches():
 def test_device_hash_failure_falls_back_to_host(monkeypatch):
     """A runtime failure in the device-hash kernel must latch off and the
     batch redo with host hashing — verification never goes down with it."""
-    from __graft_entry__ import _signed_batch
-
     v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
     msgs, pks, sigs = _signed_batch(5, seed=21)
     sigs[2] = bytes(64)
@@ -173,8 +171,6 @@ def test_device_hash_failure_falls_back_to_host(monkeypatch):
 def test_transient_device_failure_does_not_latch(monkeypatch):
     """If the host-hash retry fails TOO (device down, not a kernel bug),
     the error propagates and the device-hash latch stays on for recovery."""
-    from __graft_entry__ import _signed_batch
-
     v = ed.Ed25519TpuVerifier(kernel="w4", max_bucket=256)
     msgs, pks, sigs = _signed_batch(3, seed=22)
 

@@ -7,6 +7,8 @@ import random
 import numpy as np
 import pytest
 
+pytest.importorskip("cryptography")
+
 from hotstuff_tpu.crypto import (
     Digest,
     Signature,
