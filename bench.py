@@ -105,26 +105,35 @@ def bench_device(msgs, pks, sigs, iters: int, kernel: str = "pallas") -> float:
     return n * iters / (time.perf_counter() - t0)
 
 
+def _make_verifier(kernel: str, chunk: int, mesh: int | None):
+    """Dispatcher for the e2e/committee benches: `mesh` is None for the
+    single-chip verifier, 0 for a mesh over every attached device, or an
+    explicit device count (the --mesh N sweep axis the driver records
+    into MULTICHIP_*.json)."""
+    from hotstuff_tpu.ops import ed25519 as ed
+
+    if mesh is None:
+        return ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=chunk)
+    from hotstuff_tpu.parallel.mesh import ShardedEd25519Verifier, default_mesh
+
+    return ShardedEd25519Verifier(
+        mesh=default_mesh(mesh or None),
+        max_bucket=8192,
+        kernel=kernel,
+        chunk=chunk,
+    )
+
+
 def bench_e2e(
-    msgs, pks, sigs, kernel: str, chunk: int, iters: int, mesh: bool = False
+    msgs, pks, sigs, kernel: str, chunk: int, iters: int, mesh: int | None = None
 ) -> float:
     """Full path: packed staging (device-side hashing for 32-B digests) ->
     threaded upload pipeline -> kernel -> one mask readback (what
     QC/payload verification actually pays). With `mesh`, batches shard
-    over every attached device (ShardedEd25519Verifier)."""
-    from hotstuff_tpu.ops import ed25519 as ed
-
+    over the first `mesh` attached devices (0 = all;
+    ShardedEd25519Verifier)."""
     n = len(msgs)
-    if mesh:
-        from hotstuff_tpu.parallel.mesh import ShardedEd25519Verifier
-
-        verifier = ShardedEd25519Verifier(
-            max_bucket=8192, kernel=kernel, chunk=chunk
-        )
-    else:
-        verifier = ed.Ed25519TpuVerifier(
-            max_bucket=8192, kernel=kernel, chunk=chunk
-        )
+    verifier = _make_verifier(kernel, chunk, mesh)
     if not verifier.verify_batch_mask(msgs, pks, sigs).all():  # compile gate
         raise RuntimeError("benchmark batch must fully verify")
     t0 = time.perf_counter()
@@ -163,22 +172,30 @@ def _qc_batch(committee: int, total: int, seed: int = 7):
 
 
 def bench_committee_cache(
-    mode: str, kernel: str, chunk: int, committee: int, total: int, iters: int
+    mode: str,
+    kernel: str,
+    chunk: int,
+    committee: int,
+    total: int,
+    iters: int,
+    mesh: int | None = None,
 ) -> float:
     """A/B leg of the --committee-cache flag: a QC-shaped workload (64-node
     committee by default) through the committee-resident path (`on`: keys
     registered once, lanes gather device-resident window tables by index)
     or the generic kernel (`off`: per-batch decompression + table build).
-    Run once with each mode and `--metrics-out`, then diff the dumps with
+    With `mesh`, both legs ride ShardedEd25519Verifier over that many
+    devices (0 = all) — replicated tables vs per-batch rebuild at each
+    device count is the MULTICHIP_*.json comparison. Run once with each
+    mode and `--metrics-out`, then diff the dumps with
     tools/metrics_report.py. The zero-rebuild evidence is the counter
     DELTA across the timed loop, printed to stderr below (the process-
     global verifier.decompressions/table_builds totals also include the
     generic device/e2e benches that ran earlier in this process)."""
-    from hotstuff_tpu.ops import ed25519 as ed
     from hotstuff_tpu.utils import metrics
 
     msgs, pks, sigs, _q, _n_qc = _qc_batch(committee, total)
-    verifier = ed.Ed25519TpuVerifier(max_bucket=8192, kernel=kernel, chunk=chunk)
+    verifier = _make_verifier(kernel, chunk, mesh)
     if mode == "on":
         table = verifier.set_committee(sorted(set(pks)))
         idx = [table.index[k] for k in pks]
@@ -331,12 +348,19 @@ def main() -> None:
     )
     ap.add_argument(
         "--mesh",
-        action="store_true",
-        help="shard e2e verification over every attached device "
-        "(ShardedEd25519Verifier packed path); on a 1-chip host this "
-        "measures the mesh machinery's overhead, on CPU set "
-        "JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_"
-        "count=8 for a correctness run",
+        type=int,
+        nargs="?",
+        const=0,
+        default=None,
+        metavar="N",
+        help="shard e2e (and --committee-cache) verification over the "
+        "first N attached devices; bare --mesh means every device "
+        "(ShardedEd25519Verifier packed path). Combine with "
+        "--committee-cache {on,off} for the committee-vs-generic A/B per "
+        "device count (MULTICHIP_*.json). On a 1-chip host this measures "
+        "the mesh machinery's overhead, on CPU set JAX_PLATFORMS=cpu "
+        "XLA_FLAGS=--xla_force_host_platform_device_count=8 for a "
+        "correctness run",
     )
     args = ap.parse_args()
 
@@ -409,7 +433,7 @@ def main() -> None:
         )
         e2e_rate = bench_e2e(
             msgs, pks, sigs, args.kernel, args.chunk, args.e2e_iters,
-            mesh=args.mesh,
+            mesh=args.mesh,  # None = single chip, 0 = all devices, N = first N
         )
         committee_rate = None
         if args.committee_cache is not None:
@@ -423,6 +447,7 @@ def main() -> None:
                 64,
                 args.batch,
                 args.e2e_iters,
+                mesh=args.mesh,
             )
     except Exception as e:
         # An unusable measurement environment (e.g. missing host crypto
@@ -447,11 +472,14 @@ def main() -> None:
         )
         return
 
+    mesh_devices = None
+    if args.mesh is not None:
+        mesh_devices = len(jax.devices()[: args.mesh or None])
     print(
         f"# tpu kernel: {device_rate:,.0f} sigs/s device (batch={dn}), "
         f"{e2e_rate:,.0f} sigs/s end-to-end "
         f"(batch={args.batch}, pipelined chunk={args.chunk}"
-        f"{', mesh' if args.mesh else ''})",
+        f"{f', mesh={mesh_devices}dev' if mesh_devices else ''})",
         file=sys.stderr,
     )
 
@@ -465,6 +493,8 @@ def main() -> None:
         "cpu_multicore": round(cpu_multi, 1),
         "backend": "cpu-fallback" if cpu_fallback else jax.default_backend(),
     }
+    if mesh_devices is not None:
+        out["mesh_devices"] = mesh_devices
     if committee_rate is not None:
         out["committee_cache"] = args.committee_cache
         out["committee_value"] = round(committee_rate, 1)

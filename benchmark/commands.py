@@ -44,14 +44,20 @@ class CommandMaker:
         backend: str = "tpu",
         debug: bool = False,
         chunk: int | None = None,
+        committee: str | None = None,
     ) -> str:
         """The shared crypto sidecar: one process owns the TPU; all local
-        nodes ship their large verification batches to it."""
+        nodes ship their large verification batches to it. `committee`
+        points at the node committee file so the sidecar registers the
+        validator keys as device-resident precompute at boot (the
+        committee-tagged batches it serves then ride the
+        zero-decompression kernel)."""
         v = "-vvv" if debug else "-vv"
         chunk_arg = f" --chunk {chunk}" if chunk is not None else ""
+        committee_arg = f" --committee {committee}" if committee else ""
         return (
             f"{sys.executable} -m hotstuff_tpu.crypto.remote {v} "
-            f"--port {port} --backend {backend}{chunk_arg}"
+            f"--port {port} --backend {backend}{chunk_arg}{committee_arg}"
         )
 
     @staticmethod

@@ -121,6 +121,7 @@ class LocalBench:
                         "tpu",
                         debug=debug,
                         chunk=self.sidecar_chunk,
+                        committee=".committee.json",
                     ),
                     join("logs", "sidecar.log"),
                 )

@@ -343,6 +343,16 @@ def main(argv: list[str] | None = None) -> None:
         "coordinator from the standard JAX_COORDINATOR_ADDRESS env) and "
         "shard verification batches over every chip in the job",
     )
+    p.add_argument(
+        "--committee",
+        default=None,
+        metavar="PATH",
+        help="node committee file (node/config.py Committee JSON): register "
+        "the consensus validator keys as device-resident verification "
+        "precompute at boot — on a --multihost mesh this pushes one "
+        "replicated table copy per chip, so committee-tagged batches ride "
+        "the zero-decompression kernel on every device",
+    )
     p.add_argument("--max-delay", type=float, default=0.002)
     p.add_argument(
         "--chunk",
@@ -388,6 +398,8 @@ def main(argv: list[str] | None = None) -> None:
             p.error("--min-bucket requires --backend tpu")
         if args.chunk is not None:
             p.error("--chunk requires --backend tpu")
+        if args.committee is not None:
+            p.error("--committee requires --backend tpu")
         backend = make_backend(args.backend)
     from ..utils.logging import quiet_jax_logs
 
@@ -395,6 +407,16 @@ def main(argv: list[str] | None = None) -> None:
     if not args.no_warmup:
         warmup_backend(backend)
         quiet_jax_logs(args.verbose)  # device init may reconfigure logging
+    if args.committee is not None:
+        # After the generic warmup (device initialized) and with the same
+        # warmup policy: the committee kernel family compiles at every
+        # dispatch width before the sidecar starts serving.
+        from ..node.config import Committee as NodeCommittee
+
+        backend.register_committee(
+            NodeCommittee.read(args.committee).consensus.sorted_keys(),
+            warmup=not args.no_warmup,
+        )
     asyncio.run(
         serve(
             (args.host, args.port),

@@ -40,6 +40,12 @@ _M_CROSSOVER_FALLBACKS = metrics.counter("verifier.crossover_fallbacks")
 _M_COMMITTEE_MISSES = metrics.counter("verifier.committee_misses")
 
 
+def _is_decade(count: int) -> bool:
+    """True on the 1st, 10th, 100th, ... occurrence — the log-throttling
+    rule shared by the crossover-fallback and committee-miss warnings."""
+    return count >= 1 and count == 10 ** (len(str(count)) - 1)
+
+
 class TpuBackend(CryptoBackend):
     name = "tpu"
     # BatchVerificationService probes this to tag committee flushes.
@@ -95,11 +101,21 @@ class TpuBackend(CryptoBackend):
         # Default crossover/4 so quorum-sized QC/TC batches (2f+1 votes)
         # actually ride the device-resident tables instead of falling to
         # the host CPU; tune with bench.py --committee-cache.
-        self.committee_crossover = (
-            committee_crossover
-            if committee_crossover is not None
-            else max(1, crossover // 4)
-        )
+        if committee_crossover is not None:
+            self.committee_crossover = committee_crossover
+        else:
+            self.committee_crossover = max(1, crossover // 4)
+            # Mesh-aware floor: a sharded verifier's narrowest bucket is
+            # lane * ndev (mesh_alignment), so a sub-alignment quorum batch
+            # pads up to a FULL mesh bucket — the device pays align lanes
+            # regardless of occupancy and the break-even scales with the
+            # inflation. Keep the single-chip ratio (crossover/4 = 16
+            # against min_bucket 128, i.e. min_bucket/8).
+            align = getattr(self._verifier, "mesh_alignment", 0)
+            if align:
+                self.committee_crossover = max(
+                    self.committee_crossover, align // 8
+                )
         self._lock = threading.Lock()
         self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
@@ -132,37 +148,65 @@ class TpuBackend(CryptoBackend):
         return table.size
 
     def _warmup_widths(self) -> list[int]:
-        """Every bucket width the verifier dispatches at runtime — shared
-        by warmup() and _warmup_committee() so the two kernel families are
-        compiled at exactly the same shapes."""
+        """Batch sizes that, fed through the dispatcher, compile every
+        bucket width it can dispatch at runtime — shared by warmup() and
+        _warmup_committee() so the two kernel families are compiled at
+        exactly the same shapes.
+
+        Each candidate size is mapped through the verifier's OWN bucketing
+        and deduplicated on the resulting width: mesh alignment
+        (min_bucket = lane * ndev, max_bucket rounded to the alignment
+        grid) and pallas BLOCK rounding can collapse ladder steps onto one
+        dispatched width, and emitting the raw power-of-two ladder would
+        compile shapes the sharded verifier re-buckets and never
+        dispatches. Sizes are capped at the chunk so every warmup batch
+        dispatches as exactly one chunk (no stray split-remainder shapes).
+        """
         v = self._verifier
-        widths, w = [], v.min_bucket
         top = min(v.chunk, v.max_bucket) if hasattr(v, "chunk") else v.max_bucket
+        sizes, w = [], v.min_bucket
         while w < top:
-            widths.append(w)
+            sizes.append(w)
             w *= 2
-        # The largest shape actually dispatched for a full chunk (bucket
-        # rounding may exceed `top` when min_bucket isn't a power of two).
-        widths.append(v._bucket(top))
-        return widths
+        # The full-chunk dispatch (its bucket may exceed `top` when
+        # min_bucket isn't a power of two).
+        sizes.append(top)
+        seen, out = set(), []
+        for n in sizes:
+            width = v._bucket(n)
+            if width not in seen:
+                seen.add(width)
+                out.append(n)
+        return out
 
-    def _warmup_committee(self) -> None:
-        """Compile the committee kernel at every dispatch bucket width
-        (junk wire bytes; shapes are all that matter — see `warmup()`)."""
+    def _warmup_committee(self) -> float:
+        """Compile the committee kernel family at every dispatch bucket
+        width (junk wire bytes; shapes are all that matter — see
+        `warmup()`). Returns wall seconds spent."""
         import os
+        import time
 
+        t0 = time.perf_counter()
         v = self._verifier
-        widths = self._warmup_widths()
-        for width in widths:
+        sizes = self._warmup_widths()
+        for n in sizes:
             v.verify_batch_mask_committee(
-                [os.urandom(32)] * width, [0] * width, [os.urandom(64)] * width
+                [os.urandom(32)] * n, [0] * n, [os.urandom(64)] * n
             )
         # host-hash variant (the device-hash failure latch's fallback)
         v.verify_batch_mask_committee(
-            [os.urandom(33)] * widths[-1],
-            [0] * widths[-1],
-            [os.urandom(64)] * widths[-1],
+            [os.urandom(33)] * sizes[-1],
+            [0] * sizes[-1],
+            [os.urandom(64)] * sizes[-1],
         )
+        secs = time.perf_counter() - t0
+        log.info(
+            "committee kernel warmup: %d batch sizes (widths %s) in %.1f s",
+            len(sizes),
+            [v._bucket(n) for n in sizes],
+            secs,
+        )
+        return secs
 
     def warmup(self) -> float:
         """Force-compile every device bucket shape the verifier dispatches at
@@ -184,18 +228,25 @@ class TpuBackend(CryptoBackend):
 
         t0 = time.perf_counter()
         v = self._verifier
-        widths = self._warmup_widths()
-        for width in widths:
-            junk_m = [os.urandom(32)] * width
-            junk_k = [os.urandom(32)] * width
-            junk_s = [os.urandom(64)] * width
+        sizes = self._warmup_widths()
+        for n in sizes:
+            junk_m = [os.urandom(32)] * n
+            junk_k = [os.urandom(32)] * n
+            junk_s = [os.urandom(64)] * n
             v.verify_batch_mask(junk_m, junk_k, junk_s)
         v.verify_batch_mask(
-            [os.urandom(33)] * widths[-1],
-            [os.urandom(32)] * widths[-1],
-            [os.urandom(64)] * widths[-1],
+            [os.urandom(33)] * sizes[-1],
+            [os.urandom(32)] * sizes[-1],
+            [os.urandom(64)] * sizes[-1],
         )
-        return time.perf_counter() - t0
+        secs = time.perf_counter() - t0
+        log.info(
+            "generic kernel warmup: %d batch sizes (widths %s) in %.1f s",
+            len(sizes),
+            [v._bucket(n) for n in sizes],
+            secs,
+        )
+        return secs
 
     def verify_batch_mask(
         self,
@@ -232,7 +283,7 @@ class TpuBackend(CryptoBackend):
             # so bench runs show how often the TPU path is bypassed without
             # flooding the log at consensus rates.
             count = _M_CROSSOVER_FALLBACKS.value
-            if count >= 1 and count == 10 ** (len(str(count)) - 1):
+            if _is_decade(count):
                 log.info(
                     "sub-crossover fallback #%d: batch of %d < crossover %d "
                     "verified on host CPU",
@@ -275,4 +326,17 @@ class TpuBackend(CryptoBackend):
             return [table.index[k.data] for k in keys], table
         except KeyError:
             _M_COMMITTEE_MISSES.inc()
+            # Once per decade of misses, mirroring crossover_fallbacks:
+            # persistent misses mean the registered table is stale (epoch
+            # reconfiguration without re-registering) and committee
+            # traffic is silently riding the generic kernel.
+            count = _M_COMMITTEE_MISSES.value
+            if _is_decade(count):
+                log.info(
+                    "committee miss #%d: tagged batch of %d contains "
+                    "unregistered key(s); falling back to the generic "
+                    "kernel (re-register after reconfiguration?)",
+                    count,
+                    len(keys),
+                )
             return None

@@ -40,6 +40,11 @@ async def _run_node(args) -> None:
             host, port = args.crypto_addr.rsplit(":", 1)
             kwargs["addr"] = (host, int(port))
             kwargs["crossover"] = args.crypto_crossover
+        if args.crypto == "tpu" and args.crypto_sharded:
+            # Multi-chip: shard verification batches over every attached
+            # device. Committee registration below pushes one replicated
+            # table copy per chip (parallel/mesh.py).
+            kwargs["sharded"] = True
         backend = make_backend(args.crypto, **kwargs)
         set_backend(backend)  # returns the PREVIOUS backend — don't chain
         if not args.no_warmup:
@@ -146,6 +151,13 @@ def main(argv: list[str] | None = None) -> None:
         help="batches below this size verify on the local CPU",
     )
     p_run.add_argument(
+        "--crypto-sharded",
+        action="store_true",
+        help="with --crypto tpu: shard verification over every attached "
+        "device (ShardedEd25519Verifier); committee registration then "
+        "replicates the validator tables onto every chip",
+    )
+    p_run.add_argument(
         "--no-warmup",
         action="store_true",
         help="skip pre-compiling device kernels before joining consensus",
@@ -161,6 +173,15 @@ def main(argv: list[str] | None = None) -> None:
     p_deploy.add_argument("--nodes", type=int, required=True)
 
     args = parser.parse_args(argv)
+    if (
+        args.command == "run"
+        and args.crypto_sharded
+        and args.crypto != "tpu"
+    ):
+        # A run that silently ignored the flag would record numbers under
+        # a different config than the operator specified (same convention
+        # as the sidecar's --multihost/--chunk guards).
+        parser.error("--crypto-sharded requires --crypto tpu")
     setup_logging(args.verbose)
 
     # GIL switch interval: the saturated-node profile (data/profiles/)

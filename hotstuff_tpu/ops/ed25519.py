@@ -69,6 +69,11 @@ _M_DH_FALLBACKS = metrics.counter("verifier.device_hash_fallbacks")
 # for steady-state zero-rebuild batches.
 _M_DECOMPRESSIONS = metrics.counter("verifier.decompressions")
 _M_TABLE_BUILDS = metrics.counter("verifier.table_builds")
+# Lanes shipped only to fill a bucket (width - occupancy), summed per chunk.
+# A mesh verifier's buckets are never narrower than lane * ndev, so small
+# quorum batches inflate this counter — the visibility hook behind the
+# mesh-aware committee_crossover (sub-alignment batches belong on host CPU).
+_M_PAD_LANES = metrics.counter("verifier.pad_lanes")
 _M_COMMITTEE_BATCHES = metrics.counter("verifier.committee_batches")
 _M_COMMITTEE_SIGS = metrics.counter("verifier.committee_sigs")
 _M_COMMITTEE_REGS = metrics.counter("verifier.committee_registrations")
@@ -354,11 +359,18 @@ class CommitteeTable:
           device-hash kernel for h = SHA-512(R||A||M)
 
     `index` maps raw 32-byte key -> validator index for host-side routing.
+
+    `put` overrides device placement of the finished arrays: the mesh
+    verifier passes a replicated `NamedSharding` transfer so every chip in
+    the mesh holds its own copy of the tables (built once, at registration
+    — the sharded kernels take them as replicated shard_map operands).
     """
 
-    def __init__(self, keys: Sequence[bytes]) -> None:
+    def __init__(self, keys: Sequence[bytes], put=None) -> None:
         import jax as _jax
 
+        if put is None:
+            put = _jax.device_put
         keys = [bytes(k) for k in keys]
         if not keys:
             raise ValueError("committee must have at least one key")
@@ -389,11 +401,11 @@ class CommitteeTable:
                 ypx[k, :, i] = f.limbs_of_int((cy + cx) % P)[:, 0]
                 ymx[k, :, i] = f.limbs_of_int((cy - cx) % P)[:, 0]
                 xy2d[k, :, i] = f.limbs_of_int(D2_INT * cx * cy % P)[:, 0]
-        self.ta_ypx = _jax.device_put(ypx)
-        self.ta_ymx = _jax.device_put(ymx)
-        self.ta_xy2d = _jax.device_put(xy2d)
-        self.valid = _jax.device_put(valid)
-        self.keys_u8 = _jax.device_put(keys_u8)
+        self.ta_ypx = put(ypx)
+        self.ta_ymx = put(ymx)
+        self.ta_xy2d = put(xy2d)
+        self.valid = put(valid)
+        self.keys_u8 = put(keys_u8)
         self.size = n
 
 
@@ -863,10 +875,10 @@ class Ed25519TpuVerifier:
     mesh verifier and the legacy bit-ladder kernel).
     """
 
-    # Single-device committee-resident fast path (set_committee /
-    # verify_batch_mask_committee). The mesh subclass disables it: the
-    # committee kernel is not shard_map-wrapped, and the mesh's sharded
-    # device_put cannot place the replicated tables + 1-D index vector.
+    # Committee-resident fast path (set_committee /
+    # verify_batch_mask_committee). The mesh subclass inherits it with
+    # shard_map-wrapped kernels and per-chip replicated tables; verifier
+    # types with genuinely no committee path set this False.
     supports_committee = True
 
     def __init__(
@@ -919,10 +931,15 @@ class Ed25519TpuVerifier:
         keys = [bytes(k) for k in keys]
         if self._committee is not None and self._committee.keys == keys:
             return self._committee
-        self._committee = CommitteeTable(keys)
+        self._committee = self._build_committee_table(keys)
         _M_COMMITTEE_REGS.inc()
         _M_COMMITTEE_SIZE.set(self._committee.size)
         return self._committee
+
+    def _build_committee_table(self, keys: Sequence[bytes]) -> CommitteeTable:
+        """Placement hook: the mesh verifier overrides this to push one
+        replicated copy of the tables to every device in its mesh."""
+        return CommitteeTable(keys)
 
     def verify_batch_mask_committee(
         self,
@@ -996,6 +1013,7 @@ class Ed25519TpuVerifier:
                         signatures[lo:hi],
                     )
             width = self._bucket(hi - lo)
+            _M_PAD_LANES.inc(width - (hi - lo))
             futs.append(
                 up.submit(
                     self._upload_dispatch_committee,
@@ -1134,6 +1152,7 @@ class Ed25519TpuVerifier:
                     messages[lo:hi], keys[lo:hi], signatures[lo:hi]
                 )
             width = self._bucket(hi - lo)
+            _M_PAD_LANES.inc(width - (hi - lo))
             futs.append(
                 up.submit(
                     _upload_dispatch, fn, _pad(staged["packed"], width), self._put
@@ -1168,6 +1187,7 @@ class Ed25519TpuVerifier:
                 messages, keys, signatures, want_bits=self.kernel == "bits"
             )
         width = self._bucket(n)
+        _M_PAD_LANES.inc(width - n)
         mask = _verify_jit_args(staged, width, self.kernel)
         with metrics.span(_M_READBACK):
             host = np.asarray(mask)

@@ -6,6 +6,7 @@ from .mesh import (
     default_mesh,
     init_multihost,
     mesh_2d,
+    sharded_committee_fn,
     sharded_qc_verify_fn,
     sharded_verify_fn,
 )
@@ -15,6 +16,7 @@ __all__ = [
     "default_mesh",
     "init_multihost",
     "mesh_2d",
+    "sharded_committee_fn",
     "sharded_qc_verify_fn",
     "sharded_verify_fn",
 ]

@@ -41,6 +41,7 @@ def shard_map(f, mesh, in_specs, out_specs):
     )
 
 from ..ops import ed25519 as ed
+from ..utils import metrics
 
 
 def default_mesh(n_devices: int | None = None, axis: str = "dp") -> Mesh:
@@ -190,6 +191,34 @@ def sharded_packed_fn(
     return jax.jit(mapped)
 
 
+def sharded_committee_fn(mesh: Mesh, dp_axis: str = "dp", device_hash: bool = False):
+    """Committee-resident verification over the mesh.
+
+    The `CommitteeTable` arrays ride as REPLICATED operands (`P()` specs —
+    one device-resident copy per chip, pushed once at registration by
+    `ShardedEd25519Verifier.set_committee`); the (96, B) u8 wire rows and
+    (B,) i32 validator indices shard on `dp_axis`. Each device gathers its
+    lanes' precomputed -A window tables from its local replica — the
+    multi-chip steady state performs zero per-batch decompressions or table
+    builds, exactly like the single-chip committee path. With `device_hash`
+    the replicated committee `keys_u8` gather feeds the on-device SHA-512
+    (rows 64-95 carry 32-byte messages instead of host-computed h)."""
+    base = (
+        ed._verify_kernel_w4_committee_packed96_dh
+        if device_hash
+        else ed._verify_kernel_w4_committee_packed96
+    )
+    # (ta_ypx, ta_ymx, ta_xy2d, valid[, keys_u8]) replicated, then idx + wire
+    table_specs = (P(),) * (5 if device_hash else 4)
+    mapped = shard_map(
+        base,
+        mesh=mesh,
+        in_specs=(*table_specs, P(dp_axis), P(None, dp_axis)),
+        out_specs=P(dp_axis),
+    )
+    return jax.jit(mapped)
+
+
 class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     """Drop-in Ed25519TpuVerifier that shards batches over a mesh.
 
@@ -199,11 +228,14 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     staging + reshard). `packed=False` restores the f32-argument
     `sharded_verify_fn` path (used by the legacy bit-ladder kernel).
 
-    No committee-resident path yet: the committee kernel is not
-    shard_map-wrapped, so TpuBackend.register_committee no-ops on a
-    sharded backend (generic kernels keep serving committee traffic)."""
-
-    supports_committee = False
+    The committee-resident path (`set_committee` /
+    `verify_batch_mask_committee`) is first-class: registration pushes one
+    replicated copy of the `CommitteeTable` arrays to every chip, and the
+    committee kernels are shard_map-wrapped with the tables as replicated
+    operands while the 96 B wire rows + 4 B indices shard on the dp axis —
+    multi-chip deployments inherit the single-chip zero-decompression
+    steady state, with the same snapshot-pinned reconfig-safety contract
+    (an epoch re-registration never swaps tables under in-flight chunks)."""
 
     def __init__(self, mesh: Mesh | None = None, **kw):
         super().__init__(**kw)
@@ -219,24 +251,44 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
             from ..ops.pallas_ladder import BLOCK
 
             lane = BLOCK
-        self.min_bucket = max(self.min_bucket, lane * self._ndev)
-        # max_bucket must stay a multiple of lane*ndev or shard_map cannot
-        # split the capped bucket evenly (e.g. 3 devices: doubling 384
-        # overshoots a 8192 cap that 384 does not divide).
+        # Every bucket must stay a multiple of lane*ndev: shard_map splits
+        # the batch axis evenly across devices, and each per-device shard
+        # must keep full lanes (pallas additionally needs BLOCK-aligned
+        # shards). min_bucket rounds UP to the alignment grid (a plain max
+        # would let an off-grid user value through); max_bucket rounds down
+        # (e.g. 3 devices: doubling 384 overshoots a 8192 cap that 384 does
+        # not divide). `mesh_alignment` is the published floor — TpuBackend
+        # scales the committee crossover with it so sub-alignment quorum
+        # batches route to host CPU instead of padding up to a full mesh
+        # bucket.
         align = lane * self._ndev
+        self.mesh_alignment = align
+        self.min_bucket = -(-max(self.min_bucket, align) // align) * align
         self.max_bucket = max(align, self.max_bucket // align * align)
         self.chunk = min(self.chunk, self.max_bucket)
         dp = self.mesh.axis_names[0]
-        if self.packed:
-            from jax.sharding import NamedSharding
+        from jax.sharding import NamedSharding
 
+        # Three placement lanes: batch-axis sharded 2-D wire arrays,
+        # sharded 1-D lane vectors (committee indices), and fully
+        # replicated arrays (committee tables — one copy per chip).
+        self._put = functools.partial(
+            jax.device_put, device=NamedSharding(self.mesh, P(None, dp))
+        )
+        self._put_lanes = functools.partial(
+            jax.device_put, device=NamedSharding(self.mesh, P(dp))
+        )
+        self._replicate = functools.partial(
+            jax.device_put, device=NamedSharding(self.mesh, P())
+        )
+        self._sharded_committee = sharded_committee_fn(self.mesh, dp)
+        self._sharded_committee_dh = sharded_committee_fn(
+            self.mesh, dp, device_hash=True
+        )
+        if self.packed:
             self._sharded_packed = sharded_packed_fn(self.mesh, dp, self.kernel)
             self._sharded_packed_dh = sharded_packed_fn(
                 self.mesh, dp, self.kernel, device_hash=True
-            )
-            self._put = functools.partial(
-                jax.device_put,
-                device=NamedSharding(self.mesh, P(None, dp)),
             )
         else:
             self._fn = sharded_verify_fn(self.mesh, dp, self.kernel)
@@ -246,6 +298,37 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
 
     def _packed_dh_fn(self):
         return self._sharded_packed_dh
+
+    def _build_committee_table(self, keys):
+        """Registration-time replication: every chip in the mesh gets its
+        own device-resident copy of the window tables / validity mask /
+        key bytes, so the sharded committee kernels consume them as
+        replicated shard_map operands with zero per-batch movement."""
+        return ed.CommitteeTable(keys, put=self._replicate)
+
+    def _upload_dispatch_committee(self, ct, packed, idx, device_hash):
+        """Uploader-thread leg of the committee path over the mesh: the
+        (96, W) wire rows and (W,) index vector land SHARDED on the dp axis
+        (no device-0 staging + reshard) and dispatch against the PINNED
+        replicated tables of `ct` — a concurrent epoch re-registration must
+        not swap replicas under in-flight sharded chunks."""
+        with metrics.span(ed._M_UPLOAD):
+            dev_p = self._put(packed)
+            dev_i = self._put_lanes(idx)
+        with metrics.span(ed._M_DISPATCH):
+            if device_hash:
+                return self._sharded_committee_dh(
+                    ct.ta_ypx,
+                    ct.ta_ymx,
+                    ct.ta_xy2d,
+                    ct.valid,
+                    ct.keys_u8,
+                    dev_i,
+                    dev_p,
+                )
+            return self._sharded_committee(
+                ct.ta_ypx, ct.ta_ymx, ct.ta_xy2d, ct.valid, dev_i, dev_p
+            )
 
     def _materialize(self, masks) -> np.ndarray:
         """Multi-host mesh: the mask is sharded across PROCESSES, so a
@@ -267,5 +350,6 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
             messages, keys, signatures, want_bits=self.kernel == "bits"
         )
         width = self._bucket(n)
+        ed._M_PAD_LANES.inc(width - n)
         mask, _ = self._fn(*ed.kernel_args(staged, width, self.kernel))
         return self._materialize([mask])[:n] & staged["s_ok"]

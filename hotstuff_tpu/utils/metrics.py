@@ -469,6 +469,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     # committee-resident key precompute + verified-signature dedup
     ("verifier.decompressions", "counter", None),
     ("verifier.table_builds", "counter", None),
+    ("verifier.pad_lanes", "counter", None),
     ("verifier.committee_batches", "counter", None),
     ("verifier.committee_sigs", "counter", None),
     ("verifier.committee_registrations", "counter", None),

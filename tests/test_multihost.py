@@ -47,6 +47,15 @@ mask = v.verify_batch_mask(msgs, pks, sigs)
 want = [True] * 16
 want[5] = False
 assert mask.tolist() == want, mask.tolist()
+
+# Committee-resident path across PROCESSES: every process builds the same
+# replicated tables from the same key sequence, the sharded committee
+# kernel gathers from its local replicas, and the mask readback rides the
+# same process allgather as the generic path.
+table = v.set_committee(sorted(set(pks)))
+idx = [table.index[k] for k in pks]
+cmask = v.verify_batch_mask_committee(msgs, idx, sigs)
+assert cmask.tolist() == want, cmask.tolist()
 print("MULTIHOST-OK", pid, flush=True)
 """
 

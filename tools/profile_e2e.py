@@ -56,6 +56,17 @@ def main() -> None:
         "--cpu", action="store_true", help="CPU smoke run (forces w4 kernel)"
     )
     ap.add_argument(
+        "--mesh",
+        type=int,
+        nargs="?",
+        const=0,
+        default=None,
+        metavar="N",
+        help="add sharded phase rows over the first N attached devices "
+        "(bare --mesh = all): generic sharded e2e plus the sharded "
+        "committee path (replicated tables, 96 B + 4 B-index wire rows)",
+    )
+    ap.add_argument(
         "--metrics-out",
         default=None,
         help="write the in-process metrics dump (utils/metrics.py) here — "
@@ -181,6 +192,49 @@ def main() -> None:
     rows.append(
         _fmt(f"e2e (committee, {n} in {c}-chunks)", _t(committee_e2e, args.reps), n)
     )
+
+    # --- sharded (mesh) path ------------------------------------------------
+    # Batches shard over the dp axis; the committee tables ride as one
+    # replicated copy per chip (pushed at set_committee), so the sharded
+    # committee row should show the same zero-rebuild win as the
+    # single-chip committee row, times the device count.
+    if args.mesh is not None:
+        from hotstuff_tpu.parallel.mesh import (
+            ShardedEd25519Verifier,
+            default_mesh,
+        )
+
+        sv = ShardedEd25519Verifier(
+            mesh=default_mesh(args.mesh or None),
+            max_bucket=8192,
+            kernel=args.kernel,
+            chunk=c,
+        )
+
+        def sharded_e2e():
+            sv.verify_batch_mask(msgs, pks, sigs)
+
+        sharded_e2e()  # warm: compile the sharded generic widths
+        rows.append(
+            _fmt(
+                f"e2e (sharded, {sv._ndev} dev)", _t(sharded_e2e, args.reps), n
+            )
+        )
+
+        stable = sv.set_committee(sorted(set(pks)))
+        sidx = [stable.index[k] for k in pks]
+
+        def sharded_committee_e2e():
+            sv.verify_batch_mask_committee(msgs, sidx, sigs)
+
+        sharded_committee_e2e()  # warm: compile the sharded committee widths
+        rows.append(
+            _fmt(
+                f"e2e (sharded committee, {sv._ndev} dev)",
+                _t(sharded_committee_e2e, args.reps),
+                n,
+            )
+        )
 
     per_chunk = n // c
     print(f"# batch={n} chunk={c} chunks={per_chunk} kernel={args.kernel}")
