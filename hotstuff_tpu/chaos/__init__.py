@@ -1,0 +1,53 @@
+"""Deterministic chaos subsystem: fault injection, Byzantine adversaries,
+and live invariant checking against the real in-process consensus stack.
+
+Entry points:
+  * `run_scenario(name, seed)` — execute one named scenario from
+    `SCENARIOS` on a virtual-time loop; same seed => bit-identical fault
+    trace and honest commit sequence.
+  * `tools/chaos_run.py` — the CLI wrapper (`--scenario`, `--seed`,
+    `--report out.json`).
+
+Layering: plan.py (declarative fault schedules + seeded RNG streams) →
+transport.py (FaultyTransport at the NetSender/NetReceiver seam) →
+byzantine.py (adversary policies) → invariants.py (safety/liveness
+checkers) → orchestrator.py (node lifecycle, crash/restart) →
+scenarios.py (the library). vtime.py supplies the deterministic clock.
+"""
+
+from .byzantine import (
+    AdversaryPolicy,
+    Equivocator,
+    SigForger,
+    StaleReplayer,
+    VoteWithholder,
+)
+from .invariants import LivenessChecker, SafetyChecker
+from .orchestrator import ChaosOrchestrator, DeterministicMempool
+from .plan import CrashWindow, FaultPlan, LinkFaults, Partition, SeededRng
+from .scenarios import SCENARIOS, SHORT_SCENARIOS, run_scenario
+from .transport import FaultyTransport, NODE_LABEL
+from .vtime import VirtualTimeLoop
+
+__all__ = [
+    "AdversaryPolicy",
+    "ChaosOrchestrator",
+    "CrashWindow",
+    "DeterministicMempool",
+    "Equivocator",
+    "FaultPlan",
+    "FaultyTransport",
+    "LinkFaults",
+    "LivenessChecker",
+    "NODE_LABEL",
+    "Partition",
+    "SCENARIOS",
+    "SHORT_SCENARIOS",
+    "SafetyChecker",
+    "SeededRng",
+    "SigForger",
+    "StaleReplayer",
+    "VirtualTimeLoop",
+    "VoteWithholder",
+    "run_scenario",
+]

@@ -1,0 +1,154 @@
+"""Live safety/liveness invariant checking over honest commit streams.
+
+The orchestrator feeds every honest node's commit channel through these
+checkers DURING the run (not post-hoc), so a violation pinpoints the
+first offending commit in the fault trace timeline.
+
+Safety (2-chain HotStuff, consensus/src/messages.rs quorum rules):
+  * agreement   — no two honest nodes commit different blocks at one round;
+  * monotonic   — each node's committed rounds strictly increase (the
+                  crash-restart double-commit guard);
+  * chain-link  — consecutive commits certify their predecessor: a QC
+                  round can never fall below the last committed round, and
+                  a QC at that round must certify exactly that block
+                  (fork detection);
+  * certificates — every committed block's embedded QC re-verifies against
+                  the pure-python RFC 8032 verifier with quorum stake:
+                  zero false accepts can survive into a committed QC.
+
+Liveness: commit height advances after a declared heal point (partitions
+healed, crashed nodes restarted) — evaluated per honest node.
+"""
+
+from __future__ import annotations
+
+from ..crypto import pysigner
+from ..utils import metrics
+
+_M_CHECKS = metrics.counter("chaos.invariant_checks")
+_M_VIOLATIONS = metrics.counter("chaos.invariant_violations")
+
+
+class SafetyChecker:
+    def __init__(self, committee) -> None:
+        self.committee = committee
+        self.violations: list[str] = []
+        self._by_round: dict[int, tuple[bytes, int]] = {}  # round -> (digest, node)
+        self._last: dict[int, object] = {}  # node -> last committed block
+        self._verified_qcs: set[tuple[int, bytes]] = set()
+        self.commits: dict[int, list[tuple[int, str]]] = {}  # node -> [(round, digest)]
+
+    def _violate(self, msg: str) -> None:
+        _M_VIOLATIONS.inc()
+        self.violations.append(msg)
+
+    def on_commit(self, node: int, block) -> None:
+        _M_CHECKS.inc()
+        digest = block.digest()
+        self.commits.setdefault(node, []).append((block.round, str(digest)))
+
+        seen = self._by_round.get(block.round)
+        if seen is not None and seen[0] != digest.data:
+            self._violate(
+                f"conflicting commit at round {block.round}: node {node} "
+                f"committed {digest.short()}, node {seen[1]} committed a "
+                f"different block"
+            )
+        else:
+            self._by_round[block.round] = (digest.data, node)
+
+        prev = self._last.get(node)
+        if prev is not None:
+            if block.round <= prev.round:
+                self._violate(
+                    f"node {node} commit rounds not increasing: "
+                    f"{prev.round} then {block.round}"
+                )
+            if block.qc.round < prev.round:
+                self._violate(
+                    f"node {node} committed B{block.round} whose QC round "
+                    f"{block.qc.round} is below the previous commit "
+                    f"{prev.round} (fork)"
+                )
+            elif block.qc.round == prev.round and block.qc.hash != prev.digest():
+                self._violate(
+                    f"node {node} committed B{block.round} certifying a "
+                    f"different round-{prev.round} block than it committed"
+                )
+        self._last[node] = block
+        self._check_certificate(node, block)
+
+    def _check_certificate(self, node: int, block) -> None:
+        """Re-verify the committed block's embedded QC with the independent
+        exact-integer verifier: quorum stake AND every signature. A forged
+        vote that slipped into an assembled QC is caught here."""
+        qc = block.qc
+        if qc.is_genesis():
+            return
+        key = (qc.round, qc.hash.data)
+        if key in self._verified_qcs:
+            return
+        self._verified_qcs.add(key)
+        _M_CHECKS.inc()
+        try:
+            qc.check_quorum(self.committee)
+        except Exception as e:
+            self._violate(f"committed QC fails quorum check at node {node}: {e}")
+            return
+        msg = qc.signed_digest().data
+        for pk, sig in qc.votes:
+            if not pysigner.verify(pk.data, msg, sig.data):
+                self._violate(
+                    f"FALSE ACCEPT: committed QC (round {qc.round}) carries "
+                    f"an invalid signature by {pk.short()}"
+                )
+
+    def ok(self) -> bool:
+        return not self.violations
+
+
+class LivenessChecker:
+    """Records (node, round, virtual time) per commit; `require_progress`
+    asserts each honest node's commit height advanced past `after_t`."""
+
+    def __init__(self) -> None:
+        self._timeline: dict[int, list[tuple[float, int]]] = {}
+        self.violations: list[str] = []
+
+    def on_commit(self, node: int, block, t: float) -> None:
+        self._timeline.setdefault(node, []).append((t, block.round))
+
+    def max_round(self, node: int, up_to: float | None = None) -> int:
+        rounds = [
+            r
+            for (t, r) in self._timeline.get(node, [])
+            if up_to is None or t <= up_to
+        ]
+        return max(rounds, default=0)
+
+    def require_commits(self, honest: list[int], minimum: int = 1) -> None:
+        _M_CHECKS.inc()
+        for node in honest:
+            n = len(self._timeline.get(node, []))
+            if n < minimum:
+                _M_VIOLATIONS.inc()
+                self.violations.append(
+                    f"liveness: node {node} committed {n} blocks (< {minimum})"
+                )
+
+    def require_progress(self, after_t: float, honest: list[int]) -> None:
+        """Every honest node's commit height must have advanced after the
+        heal point (partition lifted / node restarted)."""
+        _M_CHECKS.inc()
+        for node in honest:
+            before = self.max_round(node, up_to=after_t)
+            after = self.max_round(node)
+            if after <= before:
+                _M_VIOLATIONS.inc()
+                self.violations.append(
+                    f"liveness: node {node} height stuck at {before} after "
+                    f"heal t={after_t}"
+                )
+
+    def ok(self) -> bool:
+        return not self.violations

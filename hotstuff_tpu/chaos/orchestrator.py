@@ -1,0 +1,352 @@
+"""Chaos orchestrator: boots REAL in-process consensus nodes under the
+FaultyTransport, executes a FaultPlan's crash/restart windows against
+their persisted stores, and streams every commit through the invariant
+checkers.
+
+Determinism contract: run on a VirtualTimeLoop (chaos/vtime.py) with the
+PurePythonBackend and inline verification — then a scenario is a pure
+function of (scenario definition, seed): identical fault trace, identical
+honest commit sequences, replayable bit-for-bit from a failing seed.
+
+Each node's construction happens inside a SpawnScope with the chaos
+NODE_LABEL set, so (a) the transport can attribute outbound frames to the
+node and (b) a crash is one scope.cancel() of the node's transitive task
+tree — per-peer senders, sync waiters, verification flush loops and all —
+followed by closing its store. A restart reboots the same subsystems
+against the store file the crashed incarnation persisted, which is
+exactly the double-vote-after-crash surface the persisted safety state
+exists to protect.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+
+from ..consensus import Consensus
+from ..consensus.config import Committee, Parameters
+from ..consensus.mempool_driver import (
+    MempoolCleanup,
+    MempoolGet,
+    MempoolVerify,
+    PayloadStatus,
+)
+from ..crypto import pysigner
+from ..crypto.backend import set_backend
+from ..crypto.batch_service import BatchVerificationService
+from ..crypto.primitives import Digest, PublicKey
+from ..network import net
+from ..store import Store
+from ..utils import metrics
+from ..utils.actors import SpawnScope, channel, spawn
+from .invariants import LivenessChecker, SafetyChecker
+from .plan import FaultPlan, SeededRng
+from .transport import NODE_LABEL, FaultyTransport, port_map
+
+log = logging.getLogger("hotstuff.chaos")
+
+_M_CRASHES = metrics.counter("chaos.crashes")
+_M_RESTARTS = metrics.counter("chaos.restarts")
+
+BASE_PORT = 25_000  # virtual — the transport keys on port, nothing binds
+
+
+class DeterministicMempool:
+    """MockMempool with a per-node seeded stream: answers Get with one
+    deterministic payload digest, Verify with ACCEPT (the consensus plane
+    under test orders digests; payload dissemination has its own tests)."""
+
+    def __init__(self, rng) -> None:
+        self.channel = channel()
+        self._rng = rng
+
+    def start(self) -> None:
+        spawn(self._run(), name="chaos-mempool")
+
+    async def _run(self) -> None:
+        while True:
+            msg = await self.channel.get()
+            if isinstance(msg, MempoolGet):
+                msg.reply.set_result([Digest(self._rng.randbytes(32))])
+            elif isinstance(msg, MempoolVerify):
+                msg.reply.set_result(PayloadStatus.ACCEPT)
+            elif isinstance(msg, MempoolCleanup):
+                pass
+
+
+class _NodeHandle:
+    __slots__ = (
+        "index", "pk", "seed", "store_path", "scope", "store", "service",
+        "policy", "running",
+    )
+
+    def __init__(self, index: int, pk: PublicKey, seed: bytes, store_path: str | None):
+        self.index = index
+        self.pk = pk
+        self.seed = seed
+        self.store_path = store_path
+        self.scope: SpawnScope | None = None
+        self.store: Store | None = None
+        self.service: BatchVerificationService | None = None
+        self.policy = None
+        self.running = False
+
+
+class ChaosOrchestrator:
+    def __init__(
+        self,
+        seed: int,
+        n: int = 4,
+        plan: FaultPlan | None = None,
+        byzantine: dict[int, object] | None = None,
+        parameters: Parameters | None = None,
+        store_dir: str | None = None,
+    ) -> None:
+        self.rng = SeededRng(seed)
+        self.seed = seed
+        self.n = n
+        self.plan = plan or FaultPlan()
+        self.byzantine = byzantine or {}  # index -> policy factory
+        self.parameters = parameters or Parameters(
+            timeout_delay=1_000, sync_retry_delay=1_000
+        )
+
+        key_stream = self.rng.stream("keys")
+        pairs = [
+            pysigner.keypair_from_seed(key_stream.randbytes(32))
+            for _ in range(n)
+        ]
+        # Node index = sorted-key order, matching LeaderElector rotation.
+        pairs.sort(key=lambda kp: kp[0])
+        self.keys = [(PublicKey(pk), seed_) for pk, seed_ in pairs]
+        self.committee = Committee.new(
+            [
+                (pk, 1, ("127.0.0.1", BASE_PORT + i))
+                for i, (pk, _) in enumerate(self.keys)
+            ]
+        )
+        self._own_store_dir = store_dir is None and bool(self.plan.crashes)
+        if self._own_store_dir:
+            store_dir = tempfile.mkdtemp(prefix="chaos-store-")
+        self.store_dir = store_dir
+
+        self.transport = FaultyTransport(self.plan, self.rng, port_map(self.committee))
+        self.safety = SafetyChecker(self.committee)
+        self.liveness = LivenessChecker()
+        self.honest = [i for i in range(n) if i not in self.byzantine]
+        self.events: list[dict] = []
+        self.nodes = [
+            _NodeHandle(
+                i,
+                pk,
+                seed_,
+                os.path.join(store_dir, f"node-{i}.log") if store_dir else None,
+            )
+            for i, (pk, seed_) in enumerate(self.keys)
+        ]
+
+    # -- node lifecycle ------------------------------------------------------
+
+    def _boot(self, i: int) -> None:
+        node = self.nodes[i]
+        token = NODE_LABEL.set(i)
+        scope = SpawnScope(f"chaos-node-{i}")
+        try:
+            with scope:
+                node.store = Store(node.store_path)
+                sig_service = pysigner.PySignatureService(node.seed)
+                mempool = DeterministicMempool(
+                    self.rng.stream(f"mempool:{i}")
+                )
+                mempool.start()
+                node.service = BatchVerificationService(inline=True)
+                commit_channel = channel()
+                Consensus.run(
+                    node.pk,
+                    self.committee,
+                    self.parameters,
+                    node.store,
+                    sig_service,
+                    mempool.channel,
+                    commit_channel,
+                    verification_service=node.service,
+                )
+                spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
+        finally:
+            NODE_LABEL.reset(token)
+        node.scope = scope
+        node.running = True
+        policy_factory = self.byzantine.get(i)
+        if policy_factory is not None:
+            policy = policy_factory(
+                i, node.seed, self.committee, self.rng.stream(f"byzantine:{i}")
+            )
+            self.transport.set_policy(i, policy)
+            node.policy = policy
+
+    async def _drain(self, i: int, commit_channel: asyncio.Queue) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            block = await commit_channel.get()
+            self.safety.on_commit(i, block)
+            self.liveness.on_commit(i, block, loop.time())
+
+    async def crash(self, i: int) -> None:
+        node = self.nodes[i]
+        if not node.running:
+            return
+        _M_CRASHES.inc()
+        self.events.append(
+            {"t": round(asyncio.get_running_loop().time(), 6), "event": "crash", "node": i}
+        )
+        log.info("chaos: crashing node %d", i)
+        tasks = node.scope.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if node.store is not None:
+            node.store.close()
+        node.running = False
+
+    async def restart(self, i: int) -> None:
+        node = self.nodes[i]
+        if node.running:
+            return
+        _M_RESTARTS.inc()
+        self.events.append(
+            {"t": round(asyncio.get_running_loop().time(), 6), "event": "restart", "node": i}
+        )
+        log.info("chaos: restarting node %d against %s", i, node.store_path)
+        self._boot(i)
+
+    async def _lifecycle(self) -> None:
+        """Execute the plan's crash/restart windows on the virtual clock."""
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        steps: list[tuple[float, str, int]] = []
+        for w in self.plan.crashes:
+            steps.append((w.at, "crash", w.node))
+            if w.restart is not None:
+                steps.append((w.restart, "restart", w.node))
+        for at, action, who in sorted(steps):
+            delay = start + at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if action == "crash":
+                await self.crash(who)
+            else:
+                await self.restart(who)
+
+    # -- run -----------------------------------------------------------------
+
+    def _target_met(self, min_commits: int, heal_t: float | None, start: float) -> bool:
+        """Early-stop predicate: every honest node reached the commit
+        floor, AND (for heal scenarios) the heal point has passed with
+        every honest node's height advanced beyond its at-heal height —
+        i.e. the liveness invariant is already satisfied."""
+        if not min_commits:
+            return False
+        if not all(
+            len(self.safety.commits.get(i, ())) >= min_commits
+            for i in self.honest
+        ):
+            return False
+        if heal_t is not None:
+            now = asyncio.get_running_loop().time()
+            if now < start + heal_t:
+                return False
+            for i in self.honest:
+                if self.liveness.max_round(i) <= self.liveness.max_round(
+                    i, up_to=start + heal_t
+                ):
+                    return False
+        return True
+
+    async def run(
+        self,
+        duration: float,
+        min_commits: int = 0,
+        heal_t: float | None = None,
+    ) -> dict:
+        """Boot every node, run the plan for `duration` VIRTUAL seconds
+        (stopping early once `_target_met`), tear down, and return the
+        structured report."""
+        prev_backend = set_backend(pysigner.PurePythonBackend())
+        prev_transport = net.install_transport(self.transport)
+        run_scope = SpawnScope("chaos-run")
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        try:
+            with run_scope:
+                for i in range(self.n):
+                    self._boot(i)
+                if self.plan.crashes:
+                    spawn(self._lifecycle(), name="chaos-lifecycle")
+                deadline = start + duration
+                while loop.time() < deadline:
+                    if self._target_met(min_commits, heal_t, start):
+                        break
+                    await asyncio.sleep(0.05)
+        finally:
+            for node in self.nodes:
+                if node.running and node.scope is not None:
+                    tasks = node.scope.cancel()
+                    if tasks:
+                        await asyncio.gather(*tasks, return_exceptions=True)
+                    if node.store is not None:
+                        node.store.close()
+                    node.running = False
+            stray = run_scope.cancel()
+            if stray:
+                await asyncio.gather(*stray, return_exceptions=True)
+            net.install_transport(prev_transport)
+            set_backend(prev_backend)
+            if self._own_store_dir:
+                # Self-created scratch stores die with the run (a caller-
+                # supplied store_dir is the caller's to keep); repeated
+                # seed-bisection runs must not accumulate /tmp directories.
+                import shutil
+
+                shutil.rmtree(self.store_dir, ignore_errors=True)
+        self.liveness.require_commits(self.honest, min_commits)
+        return self._report(loop.time() - start)
+
+    def _report(self, elapsed: float) -> dict:
+        return {
+            "seed": self.seed,
+            "nodes": self.n,
+            "byzantine": sorted(self.byzantine),
+            "virtual_seconds": round(elapsed, 6),
+            "plan": self.plan.to_json(),
+            "events": self.events,
+            "commits": {
+                str(i): self.safety.commits.get(i, [])
+                for i in range(self.n)
+            },
+            "fault_trace": self.transport.trace,
+            "fault_trace_overflow": self.transport.trace_overflow,
+            "safety_violations": self.safety.violations,
+            "liveness_violations": self.liveness.violations,
+            "ok": self.safety.ok() and self.liveness.ok(),
+        }
+
+    # -- adversarial bookkeeping (forged-signature scenarios) ----------------
+
+    def forged_triples_cached(self) -> int:
+        """How many adversary-forged (msg, pk, sig) triples ended up in any
+        honest node's VerifiedSigCache — must be ZERO (only successes are
+        cached, and a forged signature never verifies)."""
+        forged: list[tuple[bytes, bytes, bytes]] = []
+        for i in self.byzantine:
+            policy = getattr(self.nodes[i], "policy", None)
+            for msg, pk, sig in getattr(policy, "forged", ()):
+                forged.append((msg, pk.data, sig.data))
+        count = 0
+        for i in self.honest:
+            service = self.nodes[i].service
+            if service is None or service.dedup is None:
+                continue
+            entries = service.dedup._entries
+            count += sum(1 for t in forged if t in entries)
+        return count

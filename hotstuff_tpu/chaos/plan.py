@@ -1,0 +1,119 @@
+"""Fault plans: the declarative schedule a chaos run executes.
+
+A FaultPlan is pure data — per-directed-link fault probabilities, timed
+partitions, and crash/restart windows — interpreted by the FaultyTransport
+(link faults, partitions) and the orchestrator's lifecycle task (crashes).
+All randomness is drawn from SeededRng streams derived from ONE master
+seed, and every per-link decision depends only on (seed, src, dst,
+frame-sequence-number), so a replay with the same seed reproduces the
+identical fault trace.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from dataclasses import dataclass, field
+
+
+class SeededRng:
+    """Master seed -> named independent RNG streams.
+
+    Each stream's state depends only on (master seed, stream name) — never
+    on draw order across streams — so adding a consumer cannot perturb the
+    decisions of existing ones (the property that keeps fault traces
+    stable under scenario evolution)."""
+
+    def __init__(self, seed: int) -> None:
+        self.seed = int(seed)
+
+    def stream(self, name: str) -> random.Random:
+        digest = hashlib.sha256(f"{self.seed}:{name}".encode()).digest()
+        return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+@dataclass(frozen=True)
+class LinkFaults:
+    """Per-directed-link fault probabilities/parameters. All probabilities
+    in [0, 1]; delays in (virtual) seconds."""
+
+    drop: float = 0.0  # P(frame silently dropped)
+    duplicate: float = 0.0  # P(frame delivered twice)
+    reorder: float = 0.0  # P(frame held back past later traffic)
+    delay: float = 0.0  # base one-way latency added to every frame
+    jitter: float = 0.0  # uniform extra latency in [0, jitter]
+    reorder_delay: float = 0.05  # hold-back applied to reordered frames
+
+    def is_noop(self) -> bool:
+        return not (
+            self.drop or self.duplicate or self.reorder or self.delay or self.jitter
+        )
+
+
+@dataclass(frozen=True)
+class Partition:
+    """Between virtual times [start, end), nodes in different groups cannot
+    exchange frames. Nodes absent from every group communicate freely."""
+
+    start: float
+    end: float
+    groups: tuple[tuple[int, ...], ...]
+
+    def __post_init__(self) -> None:
+        # Membership map precomputed once: blocks() runs per frame on the
+        # transport hot path for the whole partition window.
+        object.__setattr__(
+            self,
+            "_side",
+            {n: i for i, g in enumerate(self.groups) for n in g},
+        )
+
+    def blocks(self, src: int, dst: int, now: float) -> bool:
+        if not (self.start <= now < self.end):
+            return False
+        a, b = self._side.get(src), self._side.get(dst)
+        return a is not None and b is not None and a != b
+
+
+@dataclass(frozen=True)
+class CrashWindow:
+    """Node `node` is crashed (tasks cancelled, store closed) at virtual
+    time `at`; restarted against its persisted store at `restart`
+    (None = never restarted)."""
+
+    node: int
+    at: float
+    restart: float | None = None
+
+
+@dataclass
+class FaultPlan:
+    """The full schedule. `links` overrides `default_link` per directed
+    (src, dst) pair of node indices."""
+
+    default_link: LinkFaults = field(default_factory=LinkFaults)
+    links: dict[tuple[int, int], LinkFaults] = field(default_factory=dict)
+    partitions: list[Partition] = field(default_factory=list)
+    crashes: list[CrashWindow] = field(default_factory=list)
+
+    def link(self, src: int, dst: int) -> LinkFaults:
+        return self.links.get((src, dst), self.default_link)
+
+    def partitioned(self, src: int, dst: int, now: float) -> bool:
+        return any(p.blocks(src, dst, now) for p in self.partitions)
+
+    def to_json(self) -> dict:
+        return {
+            "default_link": vars(self.default_link).copy(),
+            "links": {
+                f"{s}->{d}": vars(lf).copy() for (s, d), lf in self.links.items()
+            },
+            "partitions": [
+                {"start": p.start, "end": p.end, "groups": [list(g) for g in p.groups]}
+                for p in self.partitions
+            ],
+            "crashes": [
+                {"node": c.node, "at": c.at, "restart": c.restart}
+                for c in self.crashes
+            ],
+        }

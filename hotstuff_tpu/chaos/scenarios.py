@@ -1,0 +1,285 @@
+"""Named chaos scenarios: the library `tools/chaos_run.py --scenario`
+selects from. Each scenario is a declarative recipe — node count, fault
+plan, Byzantine policies, run bounds, heal point, and extra expectations
+evaluated against the finished report — executed by `run_scenario()` on a
+VirtualTimeLoop for deterministic replay.
+
+Link delays are deliberately nonzero everywhere: on the virtual clock a
+zero-latency network would let rounds complete in zero virtual time and a
+bounded-duration scenario would run unbounded rounds. 10-20 ms links keep
+round costs realistic AND bound the work per virtual second.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..consensus.config import Parameters
+from ..utils import metrics
+from . import vtime
+from .byzantine import Equivocator, SigForger, StaleReplayer, VoteWithholder
+from .orchestrator import ChaosOrchestrator
+from .plan import CrashWindow, FaultPlan, LinkFaults, Partition
+
+# Bounds on one scenario run. VIRTUAL_TIMEOUT_S catches a stop condition
+# that never fires (virtual time races ahead forever); WALL_TIMEOUT_S is a
+# real-clock watchdog for the opposite failure — a frozen virtual clock
+# (livelock), which no virtual deadline can interrupt.
+VIRTUAL_TIMEOUT_S = 600.0
+WALL_TIMEOUT_S = 300.0
+
+_LINK = LinkFaults(delay=0.01)  # healthy-but-realistic 10 ms links
+
+
+def _params(timeout_ms: int = 1_000) -> Parameters:
+    return Parameters(
+        timeout_delay=timeout_ms,
+        sync_retry_delay=1_000,
+        timeout_backoff=2.0,
+        max_timeout_delay=8_000,
+    )
+
+
+@dataclass
+class Scenario:
+    name: str
+    description: str
+    n: int = 4
+    plan: Callable[[], FaultPlan] = FaultPlan
+    byzantine: dict[int, object] = field(default_factory=dict)
+    parameters: Callable[[], Parameters] = _params
+    duration: float = 30.0  # virtual seconds (upper bound)
+    min_commits: int = 4  # per-honest-node early-stop / liveness floor
+    heal_t: float | None = None  # liveness must show progress past this
+    expect: Callable[[dict, dict], list[str]] | None = None  # (report, metric deltas)
+    slow: bool = False  # excluded from the tier-1 short sweep
+
+
+def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
+    if deltas.get(name, 0) < minimum:
+        return [f"expected {name} >= {minimum}, saw {deltas.get(name, 0)}"]
+    return []
+
+
+def _expect_forgery(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "chaos.forged_votes")
+    problems += _expect_counter(deltas, "verifier.rejected_sigs")
+    if report.get("forged_triples_cached", 0) != 0:
+        problems.append(
+            f"{report['forged_triples_cached']} forged triples found in a "
+            "VerifiedSigCache (rejected signatures must never be cached)"
+        )
+    return problems
+
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(s: Scenario) -> Scenario:
+    SCENARIOS[s.name] = s
+    return s
+
+
+_register(
+    Scenario(
+        name="baseline",
+        description="No faults: 4 honest nodes on healthy 10 ms links must "
+        "commit one common chain (the chaos plane's own sanity check).",
+        plan=lambda: FaultPlan(default_link=_LINK),
+    )
+)
+
+_register(
+    Scenario(
+        name="lossy_links",
+        description="Every directed link drops 8%, duplicates 3%, reorders "
+        "8%, and jitters up to 20 ms; sync retries must keep the chain "
+        "growing with no safety damage.",
+        plan=lambda: FaultPlan(
+            default_link=LinkFaults(
+                drop=0.08, duplicate=0.03, reorder=0.08, delay=0.01, jitter=0.02
+            )
+        ),
+        duration=60.0,
+        min_commits=8,
+        expect=lambda report, deltas: _expect_counter(deltas, "chaos.drops")
+        + _expect_counter(deltas, "chaos.duplicates")
+        + _expect_counter(deltas, "chaos.reorders"),
+    )
+)
+
+_register(
+    Scenario(
+        name="partition_heal",
+        description="A 2|2 partition (no quorum on either side) from t=1 to "
+        "t=4, then heal: commits must stop during the partition and resume "
+        "after — the liveness checker gates on post-heal progress.",
+        plan=lambda: FaultPlan(
+            default_link=_LINK,
+            partitions=[Partition(start=1.0, end=4.0, groups=((0, 1), (2, 3)))],
+        ),
+        duration=40.0,
+        min_commits=2,
+        heal_t=4.0,
+        expect=lambda report, deltas: _expect_counter(
+            deltas, "chaos.partition_drops"
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="leader_crash",
+        description="Node 1 crashes at t=1 and restarts at t=4 against its "
+        "persisted store: progress continues through its leader rounds via "
+        "TCs, and the restarted node may not double-vote (safety state "
+        "reload).",
+        plan=lambda: FaultPlan(
+            default_link=_LINK,
+            crashes=[CrashWindow(node=1, at=1.0, restart=4.0)],
+        ),
+        duration=40.0,
+        min_commits=3,
+        heal_t=4.0,
+        expect=lambda report, deltas: _expect_counter(deltas, "chaos.crashes")
+        + _expect_counter(deltas, "chaos.restarts"),
+    )
+)
+
+_register(
+    Scenario(
+        name="equivocating_leader",
+        description="Node 1 sends conflicting, correctly signed proposals to "
+        "different peers whenever it leads: neither twin may gather a "
+        "quorum, so its rounds fall to the pacemaker and safety holds.",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        byzantine={1: Equivocator},
+        duration=60.0,
+        min_commits=3,
+        expect=lambda report, deltas: _expect_counter(
+            deltas, "chaos.equivocations"
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="forged_signatures",
+        description="Node 1 floods votes/timeouts carrying garbage "
+        "signatures under both its own and honest authorities: the "
+        "verifier must reject every one (nonzero rejections, zero false "
+        "accepts in committed QCs, zero dedup-cache entries for forged "
+        "triples).",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        byzantine={1: SigForger},
+        duration=60.0,
+        min_commits=3,
+        expect=_expect_forgery,
+    )
+)
+
+_register(
+    Scenario(
+        name="stale_qc_replay",
+        description="Node 1 re-broadcasts old proposals and TCs on every new "
+        "round: honest replicas must discard stale rounds without state "
+        "damage or re-commits.",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        byzantine={1: StaleReplayer},
+        duration=60.0,
+        min_commits=3,
+        expect=lambda report, deltas: _expect_counter(
+            deltas, "chaos.stale_replays"
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="vote_withholding",
+        description="Node 1 withholds every vote and timeout: the remaining "
+        "2f+1 honest replicas keep committing, at pacemaker pace through "
+        "the silent node's leader rounds.",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        byzantine={1: VoteWithholder},
+        duration=60.0,
+        min_commits=3,
+        expect=lambda report, deltas: _expect_counter(
+            deltas, "chaos.withheld_votes"
+        ),
+    )
+)
+
+_register(
+    Scenario(
+        name="saturation_lossy",
+        description="Long lossy-link soak (15% drop, heavy jitter, 7 nodes, "
+        "f=2 margin) — the extended-tier variant of lossy_links.",
+        n=7,
+        plan=lambda: FaultPlan(
+            default_link=LinkFaults(
+                drop=0.15, duplicate=0.05, reorder=0.10, delay=0.01, jitter=0.04
+            )
+        ),
+        duration=240.0,
+        min_commits=5,
+        slow=True,
+    )
+)
+
+# The short sweep tier-1 runs (and the CLI's --scenario all default).
+SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
+
+_DELTA_PREFIXES = ("chaos.", "verifier.", "consensus.", "net.")
+
+
+def _counter_snapshot() -> dict:
+    return {
+        k: v
+        for k, v in metrics.dump(include_buckets=False)["counters"].items()
+        if k.startswith(_DELTA_PREFIXES)
+    }
+
+
+def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
+    """Execute one named scenario on a fresh VirtualTimeLoop; returns the
+    report dict (see ChaosOrchestrator._report) extended with the scenario
+    name, metric deltas, and expectation failures folded into `ok`."""
+    scenario = SCENARIOS[name]
+    before = _counter_snapshot()
+
+    async def body() -> dict:
+        orch = ChaosOrchestrator(
+            seed=seed,
+            n=scenario.n,
+            plan=scenario.plan(),
+            byzantine=dict(scenario.byzantine),
+            parameters=scenario.parameters(),
+        )
+        report = await orch.run(
+            duration if duration is not None else scenario.duration,
+            min_commits=scenario.min_commits,
+            heal_t=scenario.heal_t,
+        )
+        if scenario.heal_t is not None:
+            orch.liveness.require_progress(scenario.heal_t, orch.honest)
+            report["liveness_violations"] = orch.liveness.violations
+            report["ok"] = report["ok"] and orch.liveness.ok()
+        if scenario.byzantine:
+            report["forged_triples_cached"] = orch.forged_triples_cached()
+        return report
+
+    report = vtime.run(
+        body(), timeout=VIRTUAL_TIMEOUT_S, wall_timeout=WALL_TIMEOUT_S
+    )
+    after = _counter_snapshot()
+    deltas = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+    report["scenario"] = name
+    report["description"] = scenario.description
+    report["metrics"] = {k: v for k, v in sorted(deltas.items()) if v}
+    if scenario.expect is not None:
+        failures = scenario.expect(report, deltas)
+        report["expectation_failures"] = failures
+        report["ok"] = report["ok"] and not failures
+    return report

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 from ..crypto import Digest, PublicKey
 from ..network.net import NetMessage
@@ -76,7 +75,11 @@ class Synchronizer:
                 self._waiter(parent, block), name=f"sync-wait-{parent.short()}"
             )
         if parent not in self._pending:
-            self._pending[parent] = time.monotonic()
+            # Loop clock, not time.monotonic(): identical on a production
+            # loop, but under the chaos runner's virtual-time loop the
+            # retry schedule must follow VIRTUAL time or dropped sync
+            # requests would never be re-broadcast (wall time barely moves).
+            self._pending[parent] = asyncio.get_running_loop().time()
             await self._request(parent)
         return None
 
@@ -108,7 +111,7 @@ class Synchronizer:
     async def _retry_loop(self) -> None:
         while True:
             await asyncio.sleep(TIMER_ACCURACY_MS / 1000.0)
-            now = time.monotonic()
+            now = asyncio.get_running_loop().time()
             for digest, ts in list(self._pending.items()):
                 if (now - ts) * 1000.0 >= self.sync_retry_delay:
                     log.debug("retrying sync request for %s", digest.short())

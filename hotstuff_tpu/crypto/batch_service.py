@@ -126,10 +126,17 @@ class BatchVerificationService:
         max_delay: float = 0.002,
         max_concurrent_dispatches: int = 4,
         dedup_cache_size: int = 65536,
+        inline: bool = False,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
         self.max_delay = max_delay
+        # inline=True runs the backend call ON the event loop instead of a
+        # worker thread. Production keeps the thread (a TPU dispatch must
+        # not block consensus timers); the chaos runner opts in because its
+        # pure-python backend is millisecond-cheap and thread scheduling is
+        # the one nondeterminism its virtual-time replay cannot control.
+        self.inline = inline
         # Verified-signature dedup: set dedup_cache_size=0 to disable
         # (the bench A/B switch and the uncached-baseline tests).
         self.dedup: VerifiedSigCache | None = (
@@ -153,9 +160,12 @@ class BatchVerificationService:
 
     def _ensure_task(self) -> None:
         if self._task is None or self._task.done():
-            self._task = asyncio.get_running_loop().create_task(
-                self._run(), name="batch-verification-service"
-            )
+            # actors.spawn (not bare create_task): the service task then
+            # joins the caller's SpawnScope, so a chaos crash-restart of a
+            # node tears down its verification flush loop too.
+            from ..utils.actors import spawn
+
+            self._task = spawn(self._run(), name="batch-verification-service")
 
     @property
     def backend(self) -> CryptoBackend:
@@ -264,9 +274,9 @@ class BatchVerificationService:
                 self._spawn_dispatch(groups, total, False)
 
     def _spawn_dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
-        task = asyncio.get_running_loop().create_task(
-            self._dispatch(groups, total, urgent), name="verify-dispatch"
-        )
+        from ..utils.actors import spawn
+
+        task = spawn(self._dispatch(groups, total, urgent), name="verify-dispatch")
         self._dispatches.add(task)
         task.add_done_callback(self._dispatches.discard)
 
@@ -305,14 +315,16 @@ class BatchVerificationService:
                     backend, "supports_committee_routing", False
                 ):
                     kwargs["committee"] = True
+                m = msgs if full else [msgs[i] for i in miss]
+                k = keys if full else [keys[i] for i in miss]
+                s = sigs if full else [sigs[i] for i in miss]
                 try:
-                    sub = await asyncio.to_thread(
-                        backend.verify_batch_mask,
-                        msgs if full else [msgs[i] for i in miss],
-                        keys if full else [keys[i] for i in miss],
-                        sigs if full else [sigs[i] for i in miss],
-                        **kwargs,
-                    )
+                    if self.inline:
+                        sub = backend.verify_batch_mask(m, k, s, **kwargs)
+                    else:
+                        sub = await asyncio.to_thread(
+                            backend.verify_batch_mask, m, k, s, **kwargs
+                        )
                 except Exception as exc:  # backend failure must not hang callers
                     for g in groups:
                         if not g.future.done():

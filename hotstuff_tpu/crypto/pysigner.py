@@ -1,0 +1,245 @@
+"""Dependency-free ed25519 (RFC 8032) in exact host integers.
+
+The host OpenSSL wheel (`cryptography`) is optional on this stack
+(crypto/primitives.py guards it), and the JAX kernels pay a multi-minute
+XLA compile on first use — neither is acceptable inside the chaos
+subsystem, whose scenarios must boot real consensus nodes in milliseconds
+on any host. This module is the third, always-available implementation:
+pure-stdlib signing AND strict verification with the exact-integer
+Edwards arithmetic the kernel tests already trust (tests/common.py and
+tests/test_mesh_committee.py promote their fixture signer from here).
+
+Semantics match the device kernels' STRICT verification: non-canonical
+s (>= L), off-curve keys/R, and wrong-index gathers all reject — the
+chaos invariant checkers re-verify committed certificates against this
+implementation, so it must agree bit-for-bit with the hot path.
+
+Performance: extended (X:Y:Z:T) coordinates, double-and-add, one field
+inversion per compression — ~1 ms per scalar multiplication on a laptop
+core. Milliseconds per signature is fine for fault-injection scenarios
+(hundreds of signatures); it is never a production verify path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from typing import Sequence
+
+from ..utils import metrics
+from ..utils.actors import spawn
+from .backend import CryptoBackend
+from .primitives import Digest, PublicKey, Signature
+
+__all__ = [
+    "P",
+    "L",
+    "D",
+    "keypair_from_seed",
+    "sign",
+    "verify",
+    "PurePythonBackend",
+    "PySignatureService",
+]
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = -121665 * pow(121666, P - 2, P) % P
+
+_M_REJECTS = metrics.counter("verifier.rejected_sigs")
+
+# Base point (RFC 8032 §5.1): y = 4/5, x recovered with the even root.
+_BY = 4 * pow(5, P - 2, P) % P
+
+
+def _sqrt_mod_p(x2: int) -> int | None:
+    """Square root mod P (P ≡ 5 mod 8), or None when x2 is a non-residue."""
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * pow(2, (P - 1) // 4, P) % P
+    if (x * x - x2) % P != 0:
+        return None
+    return x
+
+
+def _recover_x(y: int, sign_bit: int) -> int | None:
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * pow(D * y * y + 1, P - 2, P) % P
+    x = _sqrt_mod_p(x2)
+    if x is None:
+        return None
+    if x == 0 and sign_bit:
+        return None  # -0 is not canonical
+    if x & 1 != sign_bit:
+        x = P - x
+    return x
+
+
+# Extended homogeneous coordinates (X:Y:Z:T) with x=X/Z, y=Y/Z, xy=T/Z.
+_IDENT = (0, 1, 1, 0)
+_B_POINT = None  # initialised below once _recover_x exists
+
+
+def _pt_add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _pt_mul(k: int, pt):
+    acc = _IDENT
+    while k:
+        if k & 1:
+            acc = _pt_add(acc, pt)
+        pt = _pt_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+def _pt_compress(pt) -> bytes:
+    x, y, z, _ = pt
+    zinv = pow(z, P - 2, P)
+    x, y = x * zinv % P, y * zinv % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _pt_decompress(data: bytes):
+    """Compressed 32 bytes -> extended point, or None (off-curve / non-
+    canonical y)."""
+    if len(data) != 32:
+        return None
+    enc = int.from_bytes(data, "little")
+    y = enc & ((1 << 255) - 1)
+    x = _recover_x(y, enc >> 255)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+_B_POINT = (
+    _recover_x(_BY, 0),
+    _BY,
+    1,
+    _recover_x(_BY, 0) * _BY % P,
+)
+
+
+def _clamp(h: bytes) -> int:
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def keypair_from_seed(seed: bytes) -> tuple[bytes, bytes]:
+    """32-byte seed -> (compressed public key, seed). The seed IS the
+    secret (RFC 8032 private key); signing re-derives the scalar."""
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
+    h = hashlib.sha512(seed).digest()
+    pk = _pt_compress(_pt_mul(_clamp(h), _B_POINT))
+    return pk, seed
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    """RFC 8032 Ed25519 signature (64 bytes) over `message`."""
+    h = hashlib.sha512(seed).digest()
+    a, prefix = _clamp(h), h[32:]
+    pk = _pt_compress(_pt_mul(a, _B_POINT))
+    r = int.from_bytes(hashlib.sha512(prefix + message).digest(), "little") % L
+    r_enc = _pt_compress(_pt_mul(r, _B_POINT))
+    k = (
+        int.from_bytes(hashlib.sha512(r_enc + pk + message).digest(), "little")
+        % L
+    )
+    s = (r + k * a) % L
+    return r_enc + s.to_bytes(32, "little")
+
+
+# Decompressed-key memo: committee keys recur on every certificate check,
+# and decompression (sqrt + inverse) dominates small verifies. Bounded so
+# adversarial key floods cannot grow it.
+_KEY_CACHE: dict[bytes, tuple] = {}
+_KEY_CACHE_MAX = 4096
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """STRICT verification: canonical s < L, on-curve canonical A and R,
+    full sB == R + hA — the same rejection classes the device kernels
+    implement (tests assert mask equality)."""
+    if len(signature) != 64 or len(public_key) != 32:
+        return False
+    a_pt = _KEY_CACHE.get(public_key)
+    if a_pt is None:
+        a_pt = _pt_decompress(public_key)
+        if a_pt is None:
+            return False
+        if len(_KEY_CACHE) >= _KEY_CACHE_MAX:
+            _KEY_CACHE.clear()
+        _KEY_CACHE[public_key] = a_pt
+    r_enc = signature[:32]
+    r_pt = _pt_decompress(r_enc)
+    if r_pt is None:
+        return False
+    s = int.from_bytes(signature[32:], "little")
+    if s >= L:
+        return False  # non-canonical s: malleable under cofactored rules
+    h = (
+        int.from_bytes(
+            hashlib.sha512(r_enc + public_key + message).digest(), "little"
+        )
+        % L
+    )
+    # Compare sB against R + hA in compressed form (one inversion each).
+    lhs = _pt_compress(_pt_mul(s, _B_POINT))
+    rhs = _pt_compress(_pt_add(r_pt, _pt_mul(h, a_pt)))
+    return lhs == rhs
+
+
+class PurePythonBackend(CryptoBackend):
+    """CryptoBackend over the exact-integer verifier. The chaos runner
+    installs this so fault scenarios run the REAL verification flow
+    (BatchVerificationService -> backend) on hosts with neither the
+    OpenSSL wheel nor a warmed-up accelerator."""
+
+    name = "pure-python"
+
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        signatures: Sequence[Signature],
+    ) -> list[bool]:
+        out = []
+        for msg, pk, sig in zip(messages, keys, signatures, strict=True):
+            ok = verify(pk.data, msg, sig.data)
+            if not ok:
+                _M_REJECTS.inc()
+            out.append(ok)
+        return out
+
+
+class PySignatureService:
+    """Drop-in for crypto.service.SignatureService signing with the pure
+    signer: same actor shape (queue + oneshot futures), no OpenSSL."""
+
+    def __init__(self, seed: bytes) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue(100)
+        self._task = spawn(self._run(seed), name="py-signature-service")
+
+    async def _run(self, seed: bytes) -> None:
+        while True:
+            digest, fut = await self._queue.get()
+            if not fut.cancelled():
+                fut.set_result(Signature(sign(seed, digest.data)))
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        fut = asyncio.get_running_loop().create_future()
+        await self._queue.put((digest, fut))
+        return await fut

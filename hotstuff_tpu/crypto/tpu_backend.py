@@ -38,6 +38,12 @@ _M_CPU_SIGS = metrics.counter("crypto.cpu_sigs")
 _M_BATCH_SIZE = metrics.histogram("crypto.batch_size", metrics.SIZE_BUCKETS)
 _M_CROSSOVER_FALLBACKS = metrics.counter("verifier.crossover_fallbacks")
 _M_COMMITTEE_MISSES = metrics.counter("verifier.committee_misses")
+# Adversarial-rejection visibility: forged/garbage signatures reaching the
+# backend show up here (split out for committee-tagged traffic, where a
+# rejection means a Byzantine vote/timeout hit the committee kernel's
+# rejection lanes). The chaos forged-signature scenarios assert on these.
+_M_REJECTED = metrics.counter("verifier.rejected_sigs")
+_M_COMMITTEE_REJECTED = metrics.counter("verifier.committee_rejected_sigs")
 
 
 def _is_decade(count: int) -> bool:
@@ -291,7 +297,9 @@ class TpuBackend(CryptoBackend):
                     n,
                     threshold,
                 )
-            return self._cpu.verify_batch_mask(messages, keys, signatures)
+            mask = self._cpu.verify_batch_mask(messages, keys, signatures)
+            self._count_rejections(mask, resolved is not None)
+            return mask
         with self._lock:
             self.stats["tpu_batches"] += 1
             self.stats["tpu_sigs"] += n
@@ -306,14 +314,24 @@ class TpuBackend(CryptoBackend):
                 indices,
                 [s.data for s in signatures],
                 table=table,
-            )
-            return mask.tolist()
+            ).tolist()
+            self._count_rejections(mask, True)
+            return mask
         mask = self._verifier.verify_batch_mask(
             list(messages),
             [k.data for k in keys],
             [s.data for s in signatures],
-        )
-        return mask.tolist()
+        ).tolist()
+        self._count_rejections(mask, False)
+        return mask
+
+    @staticmethod
+    def _count_rejections(mask: Sequence[bool], committee: bool) -> None:
+        bad = sum(1 for ok in mask if not ok)
+        if bad:
+            _M_REJECTED.inc(bad)
+            if committee:
+                _M_COMMITTEE_REJECTED.inc(bad)
 
     def _resolve_committee(self, keys: Sequence[PublicKey]):
         """Map keys to validator indices against ONE table snapshot;

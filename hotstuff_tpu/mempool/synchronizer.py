@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 from ..crypto import Digest, PublicKey
 from ..network.net import NetMessage
@@ -71,7 +70,9 @@ class Synchronizer:
                 block.round,
                 waiter,
                 tuple(missing),
-                time.monotonic(),
+                # Loop clock (== monotonic in production): the chaos
+                # runner's virtual-time loop must drive the retry schedule.
+                asyncio.get_running_loop().time(),
             )
             await self._request(tuple(missing), [block.author])
         return PayloadStatus.WAIT
@@ -111,7 +112,7 @@ class Synchronizer:
     async def _retry_loop(self) -> None:
         while True:
             await asyncio.sleep(TIMER_ACCURACY_MS / 1000.0)
-            now = time.monotonic()
+            now = asyncio.get_running_loop().time()
             for digest, (r, task, missing, ts) in list(self._pending.items()):
                 if (now - ts) * 1000.0 >= self.sync_retry_delay:
                     log.debug("retrying payload request for block %s", digest.short())

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import struct
 from dataclasses import dataclass
 from typing import Callable
@@ -41,8 +42,34 @@ _M_SEND_FAILURES = metrics.counter("net.send_failures")
 _M_RECONNECTS = metrics.counter("net.reconnects")
 _M_DROPPED_FULL = metrics.counter("net.dropped_full")
 _M_DECODE_ERRORS = metrics.counter("net.decode_errors")
+_M_BACKOFF_SECONDS = metrics.counter("net.backoff_seconds")
+_M_BACKOFF_DROPS = metrics.counter("net.backoff_drops")
 
 MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
+
+# ---------------------------------------------------------------------------
+# Pluggable transport (the chaos subsystem's fault-injection seam).
+#
+# When a transport is installed, NetSender/NetReceiver keep their public
+# contract (NetMessage in, decoded messages out, identical framing and
+# codec calls) but hand the socket layer to the transport: senders submit
+# framed payloads per destination, receivers register (port, deliver,
+# decode) bindings. hotstuff_tpu/chaos/transport.py installs a seeded
+# FaultyTransport here to drop/delay/duplicate/reorder/partition traffic
+# deterministically; production code never installs one and takes the TCP
+# paths below.
+
+_transport = None
+
+
+def install_transport(transport) -> object | None:
+    """Install (or, with None, remove) the process-wide transport override;
+    returns the previous one. Affects NetSender/NetReceiver instances
+    created AFTERWARDS — install before booting nodes (instances snapshot
+    the transport at construction)."""
+    global _transport
+    prev, _transport = _transport, transport
+    return prev
 
 
 @dataclass(slots=True)
@@ -135,9 +162,20 @@ class NetSender:
 
     PEER_QUEUE = 1_000
 
+    # Connect-failure backoff (per peer, jittered exponential). Without it
+    # every frame queued for an unreachable peer retries open_connection
+    # immediately: a partitioned peer with a full cold lane hot-loops
+    # SYN attempts (one per queued frame) for the whole partition.
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_MAX_S = 5.0
+
     def __init__(self, rx: asyncio.Queue, name: str = "net-sender") -> None:
         self._rx = rx
         self._name = name
+        # Captured at construction: chaos installs its transport before
+        # booting nodes, and a mid-flight install must not tear an active
+        # sender between two planes.
+        self._transport = _transport
         # addr -> (hot, cold) queues
         self._peers: dict[Address, tuple[asyncio.Queue, asyncio.Queue]] = {}
         self._task = spawn(self._run(), name=name)
@@ -165,6 +203,11 @@ class NetSender:
         while True:
             msg: NetMessage = await self._rx.get()
             payload = frame(msg.data)
+            if self._transport is not None:
+                # Chaos seam: the transport owns delivery (and the faults).
+                for addr in msg.addresses:
+                    await self._transport.send(addr, payload, urgent=msg.urgent)
+                continue
             for addr in msg.addresses:
                 lanes = self._peers.get(addr)
                 if lanes is None:
@@ -201,17 +244,44 @@ class NetSender:
         selector.add("cold", cold.get, priority=1)
         writer: asyncio.StreamWriter | None = None
         connected_before = False  # reconnects = churn, not initial connects
+        backoff = 0.0  # current backoff window (s); 0 = healthy
+        next_attempt = 0.0  # loop time before which connects are suppressed
+        loop = asyncio.get_running_loop()
         while True:
             _branch, payload = await selector.next()
             if writer is None:
+                if loop.time() < next_attempt:
+                    # Inside the backoff window: drop without a SYN. The
+                    # fire-and-forget contract already allows the drop;
+                    # what backoff buys is not hot-looping connect attempts
+                    # (one per queued frame) against a partitioned peer.
+                    _M_BACKOFF_DROPS.inc()
+                    continue
                 try:
                     _, writer = await asyncio.open_connection(addr[0], addr[1])
                     if connected_before:
                         _M_RECONNECTS.inc()
                     connected_before = True
+                    backoff = 0.0
                 except OSError as e:
                     _M_SEND_FAILURES.inc()
-                    log.debug("failed to connect to %s: %s", addr, e)
+                    # Jittered exponential growth, capped AFTER the jitter so
+                    # BACKOFF_MAX_S is a true bound: jitter decorrelates the
+                    # retry clocks of many senders all aimed at one
+                    # recovering peer (no reconnect stampede at heal time).
+                    backoff = min(
+                        max(2 * backoff, self.BACKOFF_BASE_S)
+                        * (0.5 + random.random()),
+                        self.BACKOFF_MAX_S,
+                    )
+                    next_attempt = loop.time() + backoff
+                    _M_BACKOFF_SECONDS.inc(backoff)
+                    log.debug(
+                        "failed to connect to %s: %s (backing off %.2fs)",
+                        addr,
+                        e,
+                        backoff,
+                    )
                     continue  # drop this message
             try:
                 writer.write(payload)
@@ -244,10 +314,22 @@ class NetReceiver:
         self._deliver = deliver
         self._decode = decode
         self._name = name
+        self._transport = _transport  # captured like NetSender's
         self._server: asyncio.AbstractServer | None = None
         self._task = spawn(self._run(), name=name)
 
     async def _run(self) -> None:
+        if self._transport is not None:
+            # Chaos seam: register the binding instead of a TCP listener;
+            # park until cancelled (a chaos crash), then unbind so the
+            # restarted node can re-register the port.
+            self._transport.bind(self._address, self._deliver, self._decode)
+            log.debug("%s bound on chaos transport %s", self._name, self._address)
+            try:
+                await asyncio.Event().wait()
+            finally:
+                self._transport.unbind(self._address)
+            return
         self._server = await asyncio.start_server(
             self._handle, host=self._address[0], port=self._address[1]
         )

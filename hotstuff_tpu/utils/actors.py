@@ -10,6 +10,7 @@ a select-like multiplexer for (channel, timer) loops.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import logging
 from typing import Any, Awaitable, Coroutine, TypeVar
 
@@ -27,6 +28,49 @@ def channel(capacity: int = CHANNEL_CAPACITY) -> asyncio.Queue:
 
 _tasks: set[asyncio.Task] = set()
 
+# Active SpawnScope, if any. A contextvar (not a global) so the scope
+# PROPAGATES: a task spawned while a scope is active carries the scope in
+# its context, and every task IT spawns later (per-peer net workers, sync
+# waiters, verify dispatches) lands in the same scope — the transitive
+# task tree of one in-process node, which is exactly what a chaos
+# crash-restart must cancel.
+_scope_var: contextvars.ContextVar["SpawnScope | None"] = contextvars.ContextVar(
+    "hotstuff-spawn-scope", default=None
+)
+
+
+class SpawnScope:
+    """Collects every task spawn()ed while the scope is active, including
+    transitively (see _scope_var). Used by the chaos orchestrator to model
+    a node crash as one cancel of the node's whole task tree."""
+
+    __slots__ = ("name", "tasks", "_token")
+
+    def __init__(self, name: str = "") -> None:
+        self.name = name
+        self.tasks: set[asyncio.Task] = set()
+        self._token = None
+
+    def __enter__(self) -> "SpawnScope":
+        self._token = _scope_var.set(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _scope_var.reset(self._token)
+        self._token = None
+
+    def adopt(self, task: asyncio.Task) -> None:
+        self.tasks.add(task)
+        task.add_done_callback(self.tasks.discard)
+
+    def cancel(self) -> list[asyncio.Task]:
+        """Cancel every live task in the scope; returns them so the caller
+        can await the cancellations settling."""
+        live = [t for t in self.tasks if not t.done()]
+        for t in live:
+            t.cancel()
+        return live
+
 
 def spawn(coro: Coroutine, name: str | None = None) -> asyncio.Task:
     """Spawn a long-lived actor task. Keeps a strong reference (asyncio only
@@ -34,6 +78,9 @@ def spawn(coro: Coroutine, name: str | None = None) -> asyncio.Task:
     run forever, like the reference's spawned loops."""
     task = asyncio.get_running_loop().create_task(coro, name=name)
     _tasks.add(task)
+    scope = _scope_var.get()
+    if scope is not None:
+        scope.adopt(task)
 
     def _done(t: asyncio.Task) -> None:
         _tasks.discard(t)
@@ -158,6 +205,15 @@ class Timer:
     event-based version orphans pending waiters on reset, silently killing
     the pacemaker of any replica that processed a block)."""
 
+    # Remainders below this count as due, in wait() AND expired() alike.
+    # A remainder inside the event loop's clock resolution (~1 ns) makes
+    # wait_for schedule a timeout the loop treats as ALREADY due: it fires
+    # without the clock advancing, the recomputed remainder is unchanged,
+    # and the waiter livelocks re-arming it (observed on the chaos
+    # virtual-time loop, where nothing else nudges the clock). One
+    # microsecond is far below any protocol-relevant delay.
+    RESOLUTION_S = 1e-6
+
     def __init__(self, delay_ms: int) -> None:
         self._delay = delay_ms / 1000.0
         self._deadline = 0.0
@@ -185,17 +241,22 @@ class Timer:
         return self._delay * 1000.0
 
     def expired(self) -> bool:
-        """True iff the CURRENT deadline has passed. Consumers multiplexing
-        wait() with message channels must re-check this when the timer branch
-        wins: a completed wait() may predate a reset() that raced it (a stale
-        expiry must not fire a timeout for the new round)."""
-        return asyncio.get_event_loop().time() >= self._deadline
+        """True iff the CURRENT deadline has passed (within RESOLUTION_S —
+        must agree with wait(), or a sub-resolution remainder spins the
+        selector: wait() returns 'due' while expired() says 'stale').
+        Consumers multiplexing wait() with message channels must re-check
+        this when the timer branch wins: a completed wait() may predate a
+        reset() that raced it (a stale expiry must not fire a timeout for
+        the new round)."""
+        return (
+            asyncio.get_event_loop().time() >= self._deadline - self.RESOLUTION_S
+        )
 
     async def wait(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             remaining = self._deadline - loop.time()
-            if remaining <= 0:
+            if remaining <= self.RESOLUTION_S:
                 return
             if self._moved is None:
                 self._moved = asyncio.Event()

@@ -480,6 +480,8 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("verifier.dedup_misses", "counter", None),
     ("verifier.dedup_inserts", "counter", None),
     ("verifier.dedup_evictions", "counter", None),
+    ("verifier.rejected_sigs", "counter", None),
+    ("verifier.committee_rejected_sigs", "counter", None),
     ("crypto.tpu_batches", "counter", None),
     ("crypto.tpu_sigs", "counter", None),
     ("crypto.cpu_batches", "counter", None),
@@ -518,6 +520,25 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("net.reconnects", "counter", None),
     ("net.dropped_full", "counter", None),
     ("net.decode_errors", "counter", None),
+    ("net.backoff_seconds", "counter", None),
+    ("net.backoff_drops", "counter", None),
+    # chaos/ — deterministic fault injection & invariant checking
+    ("chaos.drops", "counter", None),
+    ("chaos.delays", "counter", None),
+    ("chaos.duplicates", "counter", None),
+    ("chaos.reorders", "counter", None),
+    ("chaos.partition_drops", "counter", None),
+    ("chaos.unrouted", "counter", None),
+    ("chaos.frames", "counter", None),
+    ("chaos.forged_votes", "counter", None),
+    ("chaos.forged_timeouts", "counter", None),
+    ("chaos.equivocations", "counter", None),
+    ("chaos.stale_replays", "counter", None),
+    ("chaos.withheld_votes", "counter", None),
+    ("chaos.crashes", "counter", None),
+    ("chaos.restarts", "counter", None),
+    ("chaos.invariant_checks", "counter", None),
+    ("chaos.invariant_violations", "counter", None),
 )
 
 

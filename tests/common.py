@@ -1,7 +1,15 @@
 """Shared deterministic fixtures, mirroring the reference's tests/common.rs:
 4 keypairs from a fixed seed (consensus/src/tests/common.rs:13-16), committee
 builders, a valid 2-chain builder (:152-184), and a MockMempool that isolates
-consensus from the mempool subsystem (:187-208)."""
+consensus from the mempool subsystem (:187-208).
+
+Importable WITHOUT the host `cryptography` wheel: the OpenSSL-backed
+fixtures skip at call time (see `keys`), and the dependency-free RFC 8032
+signer — promoted out of tests/test_mesh_committee.py, canonical home
+hotstuff_tpu/crypto/pysigner.py — is re-exported here so chaos and kernel
+tests can sign on hosts that lack the wheel. Modules whose every test
+needs OpenSSL keep a module-level `pytest.importorskip("cryptography")`
+of their own."""
 
 from __future__ import annotations
 
@@ -9,10 +17,6 @@ import asyncio
 import random
 
 import pytest
-
-# Every fixture here signs with the host OpenSSL wheel; without it the
-# importing test module reports a skip instead of a collection error.
-pytest.importorskip("cryptography")
 
 from hotstuff_tpu.consensus import Block, Committee, Vote, QC
 from hotstuff_tpu.consensus.mempool_driver import (
@@ -22,12 +26,33 @@ from hotstuff_tpu.consensus.mempool_driver import (
     PayloadStatus,
 )
 from hotstuff_tpu.crypto import Digest, PublicKey, SecretKey, Signature, generate_keypair
+from hotstuff_tpu.crypto import pysigner
 from hotstuff_tpu.utils.actors import channel, spawn
 
 SEED = 0
 
 
+# --- dependency-free RFC 8032 signer (no OpenSSL, no jax) -------------------
+# rfc8032_keypair(seed) -> (compressed public key bytes, seed);
+# rfc8032_sign(keypair, msg) -> 64-byte signature. Exact-integer host math
+# matching the device kernels' strict verification bit-for-bit.
+
+def rfc8032_keypair(seed: bytes) -> tuple[bytes, bytes]:
+    return pysigner.keypair_from_seed(seed)
+
+
+def rfc8032_sign(keypair: tuple[bytes, bytes], message: bytes) -> bytes:
+    return pysigner.sign(keypair[1], message)
+
+
+def rfc8032_verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    return pysigner.verify(public_key, message, signature)
+
+
 def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
+    # OpenSSL-backed (generate_keypair signs via the `cryptography` wheel):
+    # tests calling this on a host without the wheel skip at runtime.
+    pytest.importorskip("cryptography")
     rng = random.Random(SEED)
     return [generate_keypair(rng) for _ in range(n)]
 

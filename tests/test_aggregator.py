@@ -7,6 +7,10 @@ import pytest
 from hotstuff_tpu.consensus.aggregator import Aggregator
 from hotstuff_tpu.consensus.errors import AuthorityReuseError
 from hotstuff_tpu.consensus.messages import Timeout, Vote
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import chain, committee, keys, qc_for
 
 

@@ -19,6 +19,10 @@ from hotstuff_tpu.consensus.synchronizer import Synchronizer
 from hotstuff_tpu.crypto import SignatureService
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel, spawn
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import MockMempool, chain, committee, keys, qc_for
 
 

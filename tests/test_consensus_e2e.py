@@ -9,6 +9,12 @@ from hotstuff_tpu.consensus import Consensus, Parameters
 from hotstuff_tpu.crypto import SignatureService
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel
+import pytest
+
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import MockMempool, committee, keys
 
 

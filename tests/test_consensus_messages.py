@@ -18,6 +18,10 @@ from hotstuff_tpu.consensus.messages import (
 )
 from hotstuff_tpu.crypto import Digest, Signature, generate_production_keypair
 from hotstuff_tpu.utils.serde import Reader, Writer
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import chain, committee, keys, qc_for
 
 

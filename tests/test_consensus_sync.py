@@ -13,6 +13,12 @@ from hotstuff_tpu.consensus.synchronizer import Synchronizer
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel
 from hotstuff_tpu.utils.serde import Writer
+import pytest
+
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import chain, committee, keys
 
 

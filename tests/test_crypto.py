@@ -13,6 +13,12 @@ from hotstuff_tpu.crypto import (
     generate_keypair,
     sha512_32,
 )
+import pytest
+
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import keys
 
 

@@ -303,3 +303,68 @@ def test_log_parser_no_metrics_lines_yields_empty_aggregate():
     assert p.node_metrics == []
     assert p.metrics == {"counters": {}, "histograms": {}}
     assert "+ METRICS" not in p.result()
+
+
+# ---------------------------------------------------------------------------
+# tools/chaos_run.py: the chaos scenario CLI (hotstuff_tpu/chaos)
+
+
+def test_chaos_run_cli_smoke(tmp_path):
+    """rc 0 and a well-formed JSON report from one short seeded scenario
+    (subprocess, like the node CLI tests — proves the tool runs standalone
+    without jax or the OpenSSL wheel)."""
+    import json
+    import subprocess
+    import sys
+
+    report_path = tmp_path / "chaos.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "tools", "chaos_run.py"),
+            "--scenario",
+            "baseline",
+            "--seed",
+            "1",
+            "--report",
+            str(report_path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "baseline: OK" in proc.stdout
+    report = json.loads(report_path.read_text())
+    for key in (
+        "scenario",
+        "commits",
+        "fault_trace",
+        "safety_violations",
+        "liveness_violations",
+        "metrics",
+        "ok",
+    ):
+        assert key in report, key
+    assert report["ok"] is True
+    assert report["scenario"] == "baseline"
+    assert all(len(c) >= 1 for c in report["commits"].values())
+
+
+def test_chaos_run_cli_rejects_unknown_scenario(tmp_path):
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "tools", "chaos_run.py"),
+            "--scenario",
+            "no-such-scenario",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 3
+    assert "unknown scenario" in proc.stderr

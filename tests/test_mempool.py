@@ -20,6 +20,10 @@ from hotstuff_tpu.network.net import frame
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel
 from hotstuff_tpu.utils.serde import Writer
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import chain, committee, keys
 from tests.common_mempool import mempool_committee
 

@@ -10,9 +10,10 @@ must perform zero per-batch decompressions/table builds; and an epoch
 re-registration must never swap the replicated tables under a pinned
 in-flight snapshot.
 
-Dependency-free on purpose: signatures come from an exact-integer
-pure-python RFC 8032 signer (hashlib + ops/ed25519's host Edwards
-arithmetic), so this file runs on hosts without the `cryptography` wheel.
+Dependency-free on purpose: signatures come from the exact-integer
+pure-python RFC 8032 signer shared via tests/common.py
+(hotstuff_tpu/crypto/pysigner.py), so this file runs on hosts without
+the `cryptography` wheel.
 Runs on conftest.py's virtual 8-device CPU mesh using a 4-device sub-mesh
 (the forced 4-device host-platform configuration of the acceptance check).
 """
@@ -36,53 +37,17 @@ _M_PAD = metrics.counter("verifier.pad_lanes")
 
 
 # --- dependency-free ed25519 signer (RFC 8032, exact host integers) --------
-# Reuses ops/ed25519's host-side affine Edwards addition; scalar mults are
-# plain double-and-add over Python ints (milliseconds per signature — fine
-# for a handful of test lanes, never a production path).
+# Promoted to tests/common.py (canonical implementation:
+# hotstuff_tpu/crypto/pysigner.py) so the chaos tests share it; a keypair
+# here is (compressed public key bytes, seed).
 
-_B = (ed.BX_INT, ed.BY_INT)
-
-
-def _scalar_mult(k: int, pt: tuple[int, int]) -> tuple[int, int]:
-    acc = (0, 1)
-    while k:
-        if k & 1:
-            acc = ed._edwards_add_int(acc, pt)
-        pt = ed._edwards_add_int(pt, pt)
-        k >>= 1
-    return acc
-
-
-def _compress_int(pt: tuple[int, int]) -> bytes:
-    x, y = pt
-    return (y | ((x & 1) << 255)).to_bytes(32, "little")
-
-
-def _keypair(seed: bytes) -> tuple[int, bytes, bytes]:
-    """seed -> (clamped scalar a, prefix, compressed public key A)."""
-    h = hashlib.sha512(seed).digest()
-    a = int.from_bytes(h[:32], "little")
-    a &= (1 << 254) - 8
-    a |= 1 << 254
-    return a, h[32:], _compress_int(_scalar_mult(a, _B))
-
-
-def _sign(kp: tuple[int, bytes, bytes], msg: bytes) -> bytes:
-    a, prefix, pk = kp
-    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % ed.L_ORDER
-    r_enc = _compress_int(_scalar_mult(r, _B))
-    h = (
-        int.from_bytes(hashlib.sha512(r_enc + pk + msg).digest(), "little")
-        % ed.L_ORDER
-    )
-    s = (r + h * a) % ed.L_ORDER
-    return r_enc + s.to_bytes(32, "little")
+from tests.common import rfc8032_keypair as _keypair, rfc8032_sign as _sign
 
 
 @pytest.fixture(scope="module")
 def committee():
     kps = [_keypair(bytes([i + 1]) * 32) for i in range(8)]
-    return kps, [kp[2] for kp in kps]
+    return kps, [kp[0] for kp in kps]
 
 
 @pytest.fixture(scope="module")

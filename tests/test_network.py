@@ -4,6 +4,7 @@ and broadcast over localhost with length-delimited framing."""
 import asyncio
 
 from hotstuff_tpu.network import NetMessage, NetReceiver, NetSender
+from hotstuff_tpu.utils import metrics
 from hotstuff_tpu.utils.actors import channel
 
 
@@ -74,6 +75,59 @@ def test_send_to_dead_peer_drops(run_async, base_port):
         assert await asyncio.wait_for(delivered.get(), 5.0) == b"arrives"
 
     run_async(body())
+
+
+def test_connect_backoff_suppresses_syn_hot_loop(run_async, base_port, monkeypatch):
+    """Regression: frames queued for an unreachable peer used to retry
+    open_connection once PER FRAME. With jittered exponential backoff, a
+    burst of N frames at an unreachable peer makes far fewer connect
+    attempts (the rest drop inside the backoff window), and the
+    net.backoff_seconds / net.backoff_drops counters advance."""
+
+    async def body():
+        attempts = []
+
+        async def refused(host, port):
+            attempts.append((host, port))
+            raise ConnectionRefusedError("chaos: nobody home")
+
+        monkeypatch.setattr(asyncio, "open_connection", refused)
+        backoff_s = metrics.counter("net.backoff_seconds")
+        backoff_drops = metrics.counter("net.backoff_drops")
+        s0, d0 = backoff_s.value, backoff_drops.value
+
+        tx = channel()
+        NetSender(tx, name="backoff-test")
+        dead = ("127.0.0.1", base_port)
+        n = 40
+        for i in range(n):
+            await tx.put(NetMessage(f"m{i}".encode(), [dead]))
+        # Let the worker drain the lane (first failure opens the backoff
+        # window; the rest of the burst lands inside it).
+        for _ in range(200):
+            if backoff_drops.value - d0 >= n - 5:
+                break
+            await asyncio.sleep(0.01)
+        assert len(attempts) < n / 2, (
+            f"{len(attempts)} connect attempts for {n} frames — backoff "
+            "did not suppress the SYN hot-loop"
+        )
+        assert backoff_s.value > s0
+        assert backoff_drops.value > d0
+
+        # After the window expires the worker tries again (no permanent
+        # blacklisting). The window is bounded by BACKOFF_MAX_S but its
+        # current size depends on how many attempts happened above, so keep
+        # re-sending until an attempt lands (bounded by 2x the max window).
+        before = len(attempts)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + NetSender.BACKOFF_MAX_S * 2
+        while len(attempts) == before and loop.time() < deadline:
+            await tx.put(NetMessage(b"retry", [dead]))
+            await asyncio.sleep(0.05)
+        assert len(attempts) > before
+
+    run_async(body(), timeout=NetSender.BACKOFF_MAX_S * 3)
 
 
 def test_frame_reader_bulk_and_partial(run_async, base_port):

@@ -12,6 +12,12 @@ from hotstuff_tpu.mempool import Mempool, MempoolParameters
 from hotstuff_tpu.node.client import run_client
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel, spawn
+import pytest
+
+# Whole-module OpenSSL dependency (tests/common.py is importable
+# without the wheel; the skip now lives with the modules that need it).
+pytest.importorskip("cryptography")
+
 from tests.common import keys
 from tests.common_mempool import mempool_committee
 

@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Chaos scenario runner CLI.
+
+    python tools/chaos_run.py --scenario forged_signatures --seed 7 \
+        --report out.json
+
+Runs one named scenario (or `--scenario all` for the short library) from
+hotstuff_tpu.chaos.scenarios on the deterministic virtual-time loop and
+writes a JSON report: fault trace, per-node commit sequences, invariant
+violations, chaos.* metric deltas, and an overall `ok` flag. The same
+--seed replays the identical fault trace and honest commit sequence, so a
+failing run's seed IS its reproducer.
+
+Exit codes: 0 = every invariant and expectation held; 2 = violations
+(report still written); 3 = usage error.
+
+Dependency-free on purpose: no jax, no `cryptography` — signatures ride
+the pure-python RFC 8032 implementation (hotstuff_tpu/crypto/pysigner.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hotstuff_tpu.chaos.scenarios import (  # noqa: E402
+    SCENARIOS,
+    SHORT_SCENARIOS,
+    run_scenario,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="chaos_run", description=__doc__)
+    parser.add_argument(
+        "--scenario",
+        default="all",
+        help="scenario name, or 'all' for the short library "
+        f"({', '.join(sorted(SCENARIOS))})",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--report", default=None, help="write the JSON report here")
+    parser.add_argument(
+        "--duration", type=float, default=None, help="override virtual seconds"
+    )
+    parser.add_argument(
+        "--list", action="store_true", help="list scenarios and exit"
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.WARNING,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+    if args.list:
+        for name in sorted(SCENARIOS):
+            s = SCENARIOS[name]
+            tag = " [slow]" if s.slow else ""
+            print(f"{name}{tag}: {s.description}")
+        return 0
+
+    if args.scenario == "all":
+        names = list(SHORT_SCENARIOS)
+    elif args.scenario in SCENARIOS:
+        names = [args.scenario]
+    else:
+        print(f"unknown scenario {args.scenario!r}; --list shows the library",
+              file=sys.stderr)
+        return 3
+
+    reports = []
+    all_ok = True
+    for name in names:
+        report = run_scenario(name, args.seed, duration=args.duration)
+        reports.append(report)
+        all_ok &= report["ok"]
+        commits = {n: len(c) for n, c in report["commits"].items()}
+        print(
+            f"{name}: {'OK' if report['ok'] else 'FAIL'} "
+            f"(seed {args.seed}, {report['virtual_seconds']:.1f} virtual s, "
+            f"commits {commits})"
+        )
+        for v in report["safety_violations"]:
+            print(f"  SAFETY: {v}")
+        for v in report["liveness_violations"]:
+            print(f"  LIVENESS: {v}")
+        for v in report.get("expectation_failures", ()):
+            print(f"  EXPECT: {v}")
+
+    out = reports[0] if len(reports) == 1 else {
+        "seed": args.seed,
+        "ok": all_ok,
+        "scenarios": reports,
+    }
+    if args.report:
+        with open(args.report, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if all_ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())
