@@ -271,6 +271,20 @@ def _write_metrics_safe(path: str | None, note: str | None) -> None:
         print(f"# failed to write metrics: {e}", file=sys.stderr)
 
 
+def _write_trace_safe(path: str | None) -> None:
+    """Commit the flight-recorder dump (--trace-out) alongside the metrics
+    artifact: the verifier's verify.batch events give a per-batch timeline
+    the aggregate histograms can't."""
+    if not path:
+        return
+    try:
+        from hotstuff_tpu.utils import tracing
+
+        tracing.write_json(path)
+    except OSError as e:
+        print(f"# failed to write trace dump: {e}", file=sys.stderr)
+
+
 def _degraded_note(payload: dict) -> str | None:
     note = payload.get("error") or (
         "cpu-fallback" if payload.get("backend") == "cpu-fallback" else None
@@ -280,8 +294,11 @@ def _degraded_note(payload: dict) -> str | None:
     return note
 
 
-def _emit(payload: dict, metrics_out: str | None) -> None:
+def _emit(
+    payload: dict, metrics_out: str | None, trace_out: str | None = None
+) -> None:
     _write_metrics_safe(metrics_out, _degraded_note(payload))
+    _write_trace_safe(trace_out)
     print(json.dumps(payload))
 
 
@@ -327,6 +344,12 @@ def main() -> None:
         default=None,
         help="write the structured metrics dump (utils/metrics.py) here — "
         "the committed artifact next to each BENCH_rN.json",
+    )
+    ap.add_argument(
+        "--trace-out",
+        default=None,
+        help="write the flight-recorder dump (utils/tracing.py) here — "
+        "per-batch verify.batch events alongside the aggregate metrics",
     )
     ap.add_argument(
         "--committee-cache",
@@ -406,12 +429,14 @@ def main() -> None:
                     "error": f"{type(e).__name__}: {e}",
                 },
                 args.metrics_out,
+                args.trace_out,
             )
             return
         note = "cpu-fallback" if cpu_fallback else None
         if relay_error is not None:
             note = f"{note}: {relay_error}"
         _write_metrics_safe(args.metrics_out, note)
+        _write_trace_safe(args.trace_out)
         return
 
     try:
@@ -469,6 +494,7 @@ def main() -> None:
                 "error": f"{type(e).__name__}: {e}",
             },
             args.metrics_out,
+            args.trace_out,
         )
         return
 
@@ -500,7 +526,7 @@ def main() -> None:
         out["committee_value"] = round(committee_rate, 1)
     if relay_error is not None:
         out["error"] = relay_error
-    _emit(out, args.metrics_out)
+    _emit(out, args.metrics_out, args.trace_out)
 
 
 if __name__ == "__main__":

@@ -124,6 +124,15 @@ def _parse_node(text: str) -> dict:
     shed = _search_all(r"(\d+) synthetic workload signatures skipped", text)
     # single-group findall yields plain strings
     out["workload_shed"] = int(shed[-1]) if shed else 0
+    # Anomaly-watchdog firings (utils/tracing.py): reasons + dump paths.
+    # A fired watchdog is the signal a run's numbers need the recorder
+    # dump read before being believed.
+    out["watchdog_fired"] = _search_all(
+        r"anomaly watchdog fired: (\w+)", text
+    )
+    out["watchdog_dumps"] = _search_all(
+        r"flight recorder dumped to (\S+)", text
+    )
     # METRICS snapshot lines (utils/metrics.py periodic emitter). Counters
     # are cumulative, so only the LAST well-formed snapshot per node
     # matters; a malformed blob (truncated by SIGTERM mid-line) is skipped,
@@ -193,6 +202,8 @@ class LogParser:
         self.verif_batches: list[tuple[float, int]] = []  # (t, batch size)
         self.timeouts = 0
         self.workload_shed = 0
+        self.watchdog_fired: list[str] = []  # anomaly reasons across nodes
+        self.watchdog_dumps: list[str] = []  # recorder dump paths
         # Final METRICS snapshot per node (utils/metrics.py), and the
         # cross-node aggregate (counters summed, histogram count/sum summed).
         self.node_metrics: list[dict] = []
@@ -213,6 +224,8 @@ class LogParser:
             self.verif_batches.extend(r["verif_batches"])
             self.timeouts += r["timeouts"]
             self.workload_shed += r["workload_shed"]
+            self.watchdog_fired.extend(r.get("watchdog_fired", []))
+            self.watchdog_dumps.extend(r.get("watchdog_dumps", []))
             if r.get("metrics") is not None:
                 self.node_metrics.append(r["metrics"])
         self.metrics = self._merge_metrics(self.node_metrics)
@@ -383,6 +396,13 @@ class LogParser:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"
         if self.timeouts > 2:
             warn += f" WARNING: {self.timeouts} timeouts\n"
+        if self.watchdog_fired:
+            reasons = ", ".join(sorted(set(self.watchdog_fired)))
+            warn += (
+                f" WARNING: anomaly watchdog fired {len(self.watchdog_fired)}x"
+                f" ({reasons}); {len(self.watchdog_dumps)} recorder dump(s)"
+                " written — read them before trusting these numbers\n"
+            )
         return (
             "\n-----------------------------------------\n"
             " SUMMARY:\n"

@@ -24,6 +24,7 @@ import asyncio
 import logging
 import os
 import tempfile
+import time
 
 from ..consensus import Consensus
 from ..consensus.config import Committee, Parameters
@@ -39,7 +40,7 @@ from ..crypto.batch_service import BatchVerificationService
 from ..crypto.primitives import Digest, PublicKey
 from ..network import net
 from ..store import Store
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import SpawnScope, channel, spawn
 from .invariants import LivenessChecker, SafetyChecker
 from .plan import FaultPlan, SeededRng
@@ -152,6 +153,10 @@ class ChaosOrchestrator:
     def _boot(self, i: int) -> None:
         node = self.nodes[i]
         token = NODE_LABEL.set(i)
+        # The flight recorder attributes events per node the same way the
+        # transport attributes frames: a contextvar inherited by every
+        # task the node's construction spawns.
+        trace_token = tracing.NODE_LABEL.set(i)
         scope = SpawnScope(f"chaos-node-{i}")
         try:
             with scope:
@@ -176,6 +181,7 @@ class ChaosOrchestrator:
                 spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
         finally:
             NODE_LABEL.reset(token)
+            tracing.NODE_LABEL.reset(trace_token)
         node.scope = scope
         node.running = True
         policy_factory = self.byzantine.get(i)
@@ -201,6 +207,7 @@ class ChaosOrchestrator:
         self.events.append(
             {"t": round(asyncio.get_running_loop().time(), 6), "event": "crash", "node": i}
         )
+        tracing.RECORDER.record("chaos.crash", None, None, None, label=i)
         log.info("chaos: crashing node %d", i)
         tasks = node.scope.cancel()
         if tasks:
@@ -217,6 +224,7 @@ class ChaosOrchestrator:
         self.events.append(
             {"t": round(asyncio.get_running_loop().time(), 6), "event": "restart", "node": i}
         )
+        tracing.RECORDER.record("chaos.restart", None, None, None, label=i)
         log.info("chaos: restarting node %d against %s", i, node.store_path)
         self._boot(i)
 
@@ -276,6 +284,26 @@ class ChaosOrchestrator:
         prev_transport = net.install_transport(self.transport)
         run_scope = SpawnScope("chaos-run")
         loop = asyncio.get_running_loop()
+        # Flight-recorder events follow the VIRTUAL clock for this run, so
+        # recorded timelines line up with the fault trace and replay
+        # deterministically; a fresh ring isolates the run's dump.
+        prev_clock = tracing.set_clock(loop.time)
+        tracing.reset()
+        self.watchdog_dumps: list[dict] = []
+
+        def _capture(reason: str, detail: dict) -> None:
+            # Anomaly-triggered dump, embedded in the report instead of a
+            # file: the chaos report is the artifact of record here.
+            self.watchdog_dumps.append(
+                {
+                    "t": round(loop.time(), 6),
+                    "reason": reason,
+                    "detail": detail,
+                    "events": tracing.RECORDER.events(limit=2_000),
+                }
+            )
+
+        tracing.WATCHDOG.add_dump_hook(_capture)
         start = loop.time()
         try:
             with run_scope:
@@ -302,6 +330,8 @@ class ChaosOrchestrator:
                 await asyncio.gather(*stray, return_exceptions=True)
             net.install_transport(prev_transport)
             set_backend(prev_backend)
+            tracing.WATCHDOG.remove_dump_hook(_capture)
+            tracing.set_clock(prev_clock)
             if self._own_store_dir:
                 # Self-created scratch stores die with the run (a caller-
                 # supplied store_dir is the caller's to keep); repeated
@@ -328,6 +358,23 @@ class ChaosOrchestrator:
             "fault_trace_overflow": self.transport.trace_overflow,
             "safety_violations": self.safety.violations,
             "liveness_violations": self.liveness.violations,
+            # Per-node flight-recorder dumps (one shared virtual-clock
+            # ring, filtered by node label): the cross-node stitching
+            # input for tools/trace_report.py, and the diagnosis artifact
+            # a failed scenario is debugged from.
+            "flight_recorders": {
+                str(i): tracing.RECORDER.events(node=i, limit=4_000)
+                for i in range(self.n)
+            },
+            # mono is the VIRTUAL clock the embedded events were stamped
+            # with; wall is real time, so a chaos report can be aligned
+            # against real per-node dumps like any recorder dump.
+            "trace_anchor": {
+                "mono": asyncio.get_running_loop().time(),
+                "wall": time.time(),
+            },
+            "watchdog_dumps": getattr(self, "watchdog_dumps", []),
+            "watchdog_triggers": list(tracing.WATCHDOG.triggers),
             "ok": self.safety.ok() and self.liveness.ok(),
         }
 

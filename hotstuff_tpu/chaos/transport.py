@@ -29,7 +29,7 @@ import contextvars
 import logging
 
 from ..network.net import MAX_FRAME, Address
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import spawn
 from .plan import FaultPlan, SeededRng
 
@@ -112,10 +112,17 @@ class FaultyTransport:
         data = payload[4:]  # policies and injection work on unframed bytes
         policy = self._policies.get(src)
         if policy is not None:
-            replaced = policy.on_send(src, dst, data)
+            # Policies decode codec bytes — hand them the frame WITHOUT
+            # the trace trailer, then re-append it only to the unmodified
+            # passthrough (an adversary-forged frame must not inherit the
+            # honest frame's causal token).
+            clean, ctx = tracing.strip_trailer(data, count=False)
+            replaced = policy.on_send(src, dst, clean)
             if replaced is None:
-                replaced = [data]
+                replaced = [clean]
             for out in replaced:
+                if ctx is not None and out == clean:
+                    out = out + ctx.trailer()
                 await self._submit_link(src, dst, addr[1], out, now)
             return
         await self._submit_link(src, dst, addr[1], data, now)
@@ -202,6 +209,15 @@ class FaultyTransport:
             return
         _M_NET_FRAMES_RECEIVED.inc()
         _M_NET_BYTES_RECEIVED.inc(len(data) + 4)
+        # Same trailer strip as NetReceiver: the codec never sees trace
+        # bytes, and the receive stamp is attributed to the DESTINATION
+        # node (the deliver task runs outside any node's context).
+        data, ctx = tracing.strip_trailer(data)
+        if ctx is not None:
+            tracing.note_received(ctx)
+            tracing.RECORDER.record(
+                "net.recv", ctx.trace_id, None, {"hop": ctx.hop}, label=dst
+            )
         policy = self._policies.get(dst)
         if policy is not None:
             policy.on_receive(src, dst, data)
@@ -216,6 +232,15 @@ class FaultyTransport:
     # -- trace ---------------------------------------------------------------
 
     def _record(self, t: float, src, dst, seq: int, action: str, **extra) -> None:
+        if action != "deliver":
+            # Faults (drop/partition/inject/unrouted) also land in the
+            # flight recorder, attributed to the victim destination, so a
+            # watchdog dump shows the faults leading up to an anomaly.
+            tracing.RECORDER.record(
+                "chaos.fault", None, None,
+                {"action": action, "src": src, "dst": dst},
+                label=dst,
+            )
         if len(self.trace) >= TRACE_CAP:
             self.trace_overflow += 1
             return

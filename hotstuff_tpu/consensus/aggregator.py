@@ -14,7 +14,7 @@ from __future__ import annotations
 import time
 
 from ..crypto import Digest, PublicKey, Signature
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .config import Committee
 from .errors import UnknownAuthorityError, ensure
 from .messages import QC, TC, Round, Timeout, Vote
@@ -49,7 +49,15 @@ class QCMaker:
         if self.weight >= committee.quorum_threshold():
             self.weight = 0  # fire exactly once (aggregator.rs:88)
             _M_QCS.inc()
-            _M_QC_FORM.record(time.perf_counter() - self._first_at)
+            form_s = time.perf_counter() - self._first_at
+            _M_QC_FORM.record(form_s)
+            if tracing.enabled():
+                tracing.event(
+                    "qc",
+                    tracing.trace_id(vote.round, vote.hash.data),
+                    form_s,
+                    votes=len(self.votes),
+                )
             return QC(vote.hash, vote.round, tuple(self.votes))
         return None
 

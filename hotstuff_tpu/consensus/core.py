@@ -29,7 +29,7 @@ import time
 from ..crypto import Digest, PublicKey, SignatureService
 from ..network.net import NetMessage
 from ..store import Store
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import Selector, Timer, spawn
 from ..utils.serde import Reader, Writer
 from .aggregator import Aggregator
@@ -147,9 +147,13 @@ class Core:
 
     # -- helpers -------------------------------------------------------------
 
-    async def _transmit(self, msg, to: PublicKey | None) -> None:
+    async def _transmit(
+        self, msg, to: PublicKey | None, trace: "tracing.TraceContext | None" = None
+    ) -> None:
         """Send to one authority, or broadcast to all others when to is None
-        (consensus/src/synchronizer.rs:109-129 transmit helper)."""
+        (consensus/src/synchronizer.rs:109-129 transmit helper). `trace`
+        rides the frame trailer (utils/tracing.py) for cross-node
+        commit-latency attribution."""
         data = encode_consensus_message(msg)
         if to is not None:
             addr = self.committee.address(to)
@@ -157,7 +161,15 @@ class Core:
         else:
             addrs = self.committee.broadcast_addresses(self.name)
         if addrs:
-            await self.network_tx.put(NetMessage(data, addrs))
+            await self.network_tx.put(NetMessage(data, addrs, trace=trace))
+
+    @staticmethod
+    def _trace_ctx(round_: Round, digest: Digest) -> "tracing.TraceContext | None":
+        """Outbound trace context for block (round, digest); None with
+        tracing disabled so the wire stays trailer-free."""
+        if not tracing.enabled():
+            return None
+        return tracing.context_for(round_, digest.data)
 
     async def _store_block(self, block: Block) -> None:
         w = Writer()
@@ -217,6 +229,13 @@ class Core:
             seen = self._block_seen.pop(d, None)
             if seen is not None:
                 _M_COMMIT_LATENCY.record(now - seen)
+            if tracing.enabled():
+                tracing.event(
+                    "commit",
+                    tracing.trace_id(b.round, d.data),
+                    (now - seen) if seen is not None else None,
+                    round=b.round,
+                )
             # NOTE: These log entries are used to compute performance.
             log.info("Committed B%s(%s)", b.round, d)
             for payload_digest in b.payload:
@@ -227,6 +246,12 @@ class Core:
 
     async def _process_qc(self, qc: QC) -> None:
         """Adopt a higher QC and advance past its round (core.rs:263-276,321)."""
+        if qc.round > self.high_qc.round and tracing.enabled():
+            # QC-assembly stage on NON-assembling nodes: the first time
+            # this node sees a quorum certificate for the block.
+            tracing.event(
+                "qc", tracing.trace_id(qc.round, qc.hash.data), adopted=True
+            )
         if qc.round >= self.round and self._consecutive_timeouts:
             # A QC advancing the round is real progress: restore the base
             # pacemaker delay. (TC-driven advances deliberately keep the
@@ -254,6 +279,13 @@ class Core:
     async def _local_timeout_round(self) -> None:
         """Pacemaker fired (core.rs:175-197)."""
         _M_TIMEOUTS.inc()
+        tracing.event(
+            "timeout", round=self.round,
+            consecutive=self._consecutive_timeouts + 1,
+        )
+        tracing.WATCHDOG.note_timeout(
+            self.round, self._consecutive_timeouts + 1
+        )
         log.warning("Timeout reached for round %s", self.round)
         self.last_voted_round = max(self.last_voted_round, self.round)
         await self._store_safety_state()
@@ -290,19 +322,26 @@ class Core:
 
     async def _generate_proposal(self, tc: TC | None) -> None:
         """Leader path (core.rs:278-318)."""
+        t0 = time.perf_counter()
         payload = await self.mempool_driver.get(self.parameters.max_payload_size)
+        payload_dur = time.perf_counter() - t0
         digest = Block.make_digest(self.name, self.round, payload, self.high_qc)
         signature = await self.signature_service.request_signature(digest)
         block = Block(
             self.high_qc, tc, self.name, self.round, tuple(payload), signature
         )
         _M_PROPOSALS.inc()
+        if tracing.enabled():
+            tid = tracing.trace_id(block.round, digest.data)
+            tracing.event("propose", tid, origin=True)
+            # The leader's payload-fetch leg is the mempool Get above.
+            tracing.event("payload", tid, payload_dur, digests=len(payload))
         if block.payload:
             # NOTE: This log entry is used to compute performance.
             log.info("Created B%s(%s)", block.round, block.digest())
         else:
             log.debug("Created empty %s", block)
-        await self._transmit(block, None)
+        await self._transmit(block, None, trace=self._trace_ctx(block.round, digest))
         await self._process_block(block)
 
     async def _process_block(self, block: Block) -> None:
@@ -335,26 +374,59 @@ class Core:
             return
         _M_VOTES.inc()
         _M_PROPOSAL_TO_VOTE.record(time.perf_counter() - t0)
+        if tracing.enabled():
+            tracing.event(
+                "vote", tracing.trace_id(block.round, block.digest().data)
+            )
         log.debug("created %s", vote)
         next_leader = self.leader_elector.get_leader(self.round + 1)
         if next_leader == self.name:
             await self._handle_vote(vote)
         else:
-            await self._transmit(vote, next_leader)
+            await self._transmit(
+                vote, next_leader,
+                trace=self._trace_ctx(vote.round, vote.hash),
+            )
 
     # -- message handlers ----------------------------------------------------
 
     async def _handle_proposal(self, block: Block) -> None:
         digest = block.digest()
+        # Disabled-mode fast path: skip the trace-id formatting and the
+        # extra clock reads entirely (tid=None keeps service groups untagged).
+        traced = tracing.enabled()
+        tid = tracing.trace_id(block.round, digest.data) if traced else None
+        if traced:
+            tracing.event("propose", tid)
         leader = self.leader_elector.get_leader(block.round)
         ensure(
             block.author == leader, WrongLeaderError(block.round, block.author, leader)
         )
-        await block.verify_async(self.committee, self.verification_service)
+        t0 = time.perf_counter()
+        await block.verify_async(
+            self.committee, self.verification_service, trace=tid
+        )
+        if traced:
+            dur = time.perf_counter() - t0
+            tracing.event("verify", tid, dur)
+            if not block.qc.is_genesis():
+                # Verifying this block also verified its embedded QC — the
+                # verify leg of the PARENT block's lifecycle on this node.
+                tracing.event(
+                    "verify",
+                    tracing.trace_id(block.qc.round, block.qc.hash.data),
+                    dur,
+                    via=tid,
+                )
         await self._process_qc(block.qc)
         if block.tc is not None:
             await self._advance_round(block.tc.round)
+        t0 = time.perf_counter()
         available = await self.mempool_driver.verify(block)
+        if traced:
+            tracing.event(
+                "payload", tid, time.perf_counter() - t0, available=available
+            )
         if not available:
             log.debug("%s waiting for payload availability", block)
             return
@@ -363,7 +435,14 @@ class Core:
     async def _handle_vote(self, vote: Vote) -> None:
         if vote.round < self.round:
             return
-        await vote.verify_async(self.committee, self.verification_service)
+        traced = tracing.enabled()
+        tid = tracing.trace_id(vote.round, vote.hash.data) if traced else None
+        t0 = time.perf_counter()
+        await vote.verify_async(
+            self.committee, self.verification_service, trace=tid
+        )
+        if traced:
+            tracing.event("verify", tid, time.perf_counter() - t0, vote=True)
         qc = self.aggregator.add_vote(vote)
         if qc is not None:
             log.debug("assembled %s", qc)

@@ -104,16 +104,21 @@ class QC:
         d = self.signed_digest().data
         return [d] * len(self.votes), list(self.votes)
 
-    async def verify_async(self, committee: Committee, service) -> None:
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
         """verify() with the signature batch routed through the
         BatchVerificationService (off-loop, coalesced with other pending
         requests) instead of a synchronous backend call in the actor loop.
         Tagged `committee=True`: every vote is signed by a registered
         validator key, so the batch rides the committee-resident kernel
-        (and dedup-cached votes skip the backend entirely)."""
+        (and dedup-cached votes skip the backend entirely). `trace` tags
+        the service group with the block's trace id (utils/tracing.py)."""
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
-        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
+        mask = await service.verify_group(
+            msgs, pairs, urgent=True, committee=True, trace=trace
+        )
         ensure(all(mask), InvalidSignatureError("QC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
@@ -163,10 +168,14 @@ class TC:
         ok = Signature.verify_batch_alt(msgs, pairs)
         ensure(ok, InvalidSignatureError("TC batch verification failed"))
 
-    async def verify_async(self, committee: Committee, service) -> None:
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
-        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
+        mask = await service.verify_group(
+            msgs, pairs, urgent=True, committee=True, trace=trace
+        )
         ensure(all(mask), InvalidSignatureError("TC batch verification failed"))
 
     def encode(self, w: Writer) -> None:
@@ -269,7 +278,9 @@ class Block:
         if self.tc is not None:
             self.tc.verify(committee)
 
-    async def verify_async(self, committee: Committee, service) -> None:
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
         """verify() with ALL signature checks (author + embedded QC + embedded
         TC) submitted as ONE group to the BatchVerificationService: a single
         coalesced backend dispatch per block instead of three synchronous
@@ -290,7 +301,9 @@ class Block:
             tc_lo, tc_hi = len(msgs), len(msgs) + len(m)
             msgs += m
             pairs += p
-        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
+        mask = await service.verify_group(
+            msgs, pairs, urgent=True, committee=True, trace=trace
+        )
         ensure(mask[0], InvalidSignatureError(f"bad block signature B{self.round}"))
         ensure(
             all(mask[qc_lo:qc_hi]),
@@ -356,11 +369,13 @@ class Vote:
         ok = self.signature.verify(self.signed_digest(), self.author)
         ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
 
-    async def verify_async(self, committee: Committee, service) -> None:
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
         ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = await service.verify(
             self.signed_digest().data, self.author, self.signature,
-            committee=True,
+            committee=True, trace=trace,
         )
         ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
 
@@ -407,7 +422,9 @@ class Timeout:
         if not self.high_qc.is_genesis():
             self.high_qc.verify(committee)
 
-    async def verify_async(self, committee: Committee, service) -> None:
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
         """Timeout signature + embedded high_qc votes as one service group."""
         ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
         msgs: list[bytes] = [self.signed_digest().data]
@@ -417,7 +434,9 @@ class Timeout:
             m, p = self.high_qc.signed_items()
             msgs += m
             pairs += p
-        mask = await service.verify_group(msgs, pairs, urgent=True, committee=True)
+        mask = await service.verify_group(
+            msgs, pairs, urgent=True, committee=True, trace=trace
+        )
         ensure(mask[0], InvalidSignatureError(f"bad timeout signature T{self.round}"))
         ensure(
             all(mask[1:]),

@@ -16,7 +16,7 @@ import logging
 from ..crypto import Digest, PublicKey
 from ..network.net import NetMessage
 from ..store import Store
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import spawn
 from .config import Committee
 from .messages import (
@@ -104,6 +104,8 @@ class Synchronizer:
 
     async def _request(self, digest: Digest) -> None:
         _M_SYNC_REQUESTS.inc()
+        if tracing.enabled():
+            tracing.event("sync.request", digest=digest.short())
         data = encode_consensus_message(SyncRequest(digest, self.name))
         addrs = self.committee.broadcast_addresses(self.name)
         await self.network_tx.put(NetMessage(data, addrs))
@@ -116,4 +118,6 @@ class Synchronizer:
                 if (now - ts) * 1000.0 >= self.sync_retry_delay:
                     log.debug("retrying sync request for %s", digest.short())
                     _M_SYNC_RETRIES.inc()
+                    if tracing.enabled():
+                        tracing.event("sync.retry", digest=digest.short())
                     await self._request(digest)

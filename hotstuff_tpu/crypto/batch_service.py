@@ -29,11 +29,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Sequence
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from .backend import CryptoBackend, get_backend
 from .primitives import PublicKey, Signature
 
@@ -112,6 +113,10 @@ class _Group:
     # pre-signed triples, and caching those would make the benchmark
     # measure the cache instead of the backend.
     dedup: bool = True
+    # Causal trace id (utils/tracing.py): set for consensus groups so the
+    # flight recorder can attribute this batch's verification cost to the
+    # block whose QC/vote/proposal it checks.
+    trace: str | None = None
     future: asyncio.Future = field(default_factory=lambda: asyncio.get_running_loop().create_future())
 
     def __len__(self) -> int:
@@ -180,6 +185,7 @@ class BatchVerificationService:
         urgent: bool = False,
         committee: bool = False,
         dedup: bool = True,
+        trace: str | None = None,
     ) -> list[bool]:
         """Submit a correlated group (e.g. one QC's votes or one synthetic
         payload batch); resolves to the per-item validity mask once the
@@ -187,7 +193,9 @@ class BatchVerificationService:
         by registered validator keys, routing it to the backend's
         committee-resident kernel when available; `dedup=False` bypasses
         the verified-signature cache (synthetic benchmark load, where
-        repeats are intentional and must pay full verification)."""
+        repeats are intentional and must pay full verification); `trace`
+        tags the group with a causal trace id so the flight recorder can
+        attribute the batch's cost to the block it checks."""
         if not messages:
             return []
         self._ensure_task()
@@ -198,6 +206,7 @@ class BatchVerificationService:
             urgent,
             committee,
             dedup,
+            trace,
         )
         await self._queue.put(group)
         return await group.future
@@ -209,10 +218,11 @@ class BatchVerificationService:
         signature: Signature,
         urgent: bool = True,
         committee: bool = False,
+        trace: str | None = None,
     ) -> bool:
         """Await a single verification (batched under the hood)."""
         mask = await self.verify_group(
-            [message], [(key, signature)], urgent, committee
+            [message], [(key, signature)], urgent, committee, trace=trace
         )
         return mask[0]
 
@@ -318,6 +328,7 @@ class BatchVerificationService:
                 m = msgs if full else [msgs[i] for i in miss]
                 k = keys if full else [keys[i] for i in miss]
                 s = sigs if full else [sigs[i] for i in miss]
+                t0 = time.perf_counter()
                 try:
                     if self.inline:
                         sub = backend.verify_batch_mask(m, k, s, **kwargs)
@@ -330,6 +341,18 @@ class BatchVerificationService:
                         if not g.future.done():
                             g.future.set_exception(exc)
                     return
+                dur = time.perf_counter() - t0
+                if tracing.enabled():
+                    # One verify.batch event per traced group in the flush
+                    # (batch tags), plus a watchdog sample of the flush's
+                    # per-signature cost for regression detection.
+                    for g in groups:
+                        if g.trace is not None:
+                            tracing.event(
+                                "verify.batch", g.trace, dur,
+                                n=len(g), flush=len(miss),
+                            )
+                    tracing.WATCHDOG.note_verify(dur, len(miss))
                 for i, ok in zip(miss, sub):
                     mask[i] = bool(ok)
                     if ok and cache is not None and dedupable[i]:

@@ -19,7 +19,7 @@ import os
 from ..crypto import Digest, PublicKey, Signature, generate_keypair
 from ..network.net import NetMessage
 from ..store import Store
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import Selector, spawn
 from ..utils.serde import Reader, Writer
 from ..consensus.mempool_driver import (
@@ -235,8 +235,15 @@ class Core:
         # (core.rs:174-175).
         addrs = self.committee.broadcast_addresses(self.name)
         if addrs:
+            # Payload gossip rides its own trace lane (round 0 + payload
+            # digest prefix): the consensus-side "payload" stage then shows
+            # WHETHER availability stalled, and these events show WHY.
+            trace = None
+            if tracing.enabled():
+                trace = tracing.TraceContext(0, digest.data)
+                tracing.event("payload.gossip", trace.trace_id, peers=len(addrs))
             await self.network_tx.put(
-                NetMessage(encode_mempool_message(payload), addrs)
+                NetMessage(encode_mempool_message(payload), addrs, trace=trace)
             )
         self._queue_insert(digest)
         return digest
@@ -293,6 +300,10 @@ class Core:
         # verifies pre-generated triples, mempool/src/core.rs:211-224 — the
         # outcome is measured, not consumed).
         await self._store_payload(payload)
+        if tracing.enabled():
+            tracing.event(
+                "payload.stored", tracing.trace_id(0, payload.digest().data)
+            )
         self._queue_insert(payload.digest())
         # The synthetic OTHER batch rides the capped pipeline; at a full
         # pipeline the measurement load is skipped so acceptance never
@@ -340,12 +351,17 @@ class Core:
             if raw is not None:
                 _M_REQUESTS_SERVED.inc()
                 payload = Payload.decode(Reader(raw))
+                trace = None
+                if tracing.enabled():
+                    trace = tracing.context_for(0, digest.data)
+                    tracing.event("payload.served", trace.trace_id)
                 # Urgent: the requester's consensus is stalled on this
                 # payload; behind the gossip backlog it would drop and the
                 # requester would re-broadcast forever.
                 await self.network_tx.put(
                     NetMessage(
-                        encode_mempool_message(payload), [addr], urgent=True
+                        encode_mempool_message(payload), [addr], urgent=True,
+                        trace=trace,
                     )
                 )
 

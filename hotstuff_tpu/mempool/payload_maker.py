@@ -12,6 +12,7 @@ import asyncio
 import logging
 
 from ..crypto import PublicKey, SignatureService
+from ..utils import tracing
 from ..utils.actors import Selector, channel, spawn
 from .messages import OwnPayload, Payload, Transaction
 
@@ -44,6 +45,7 @@ class PayloadMaker:
         # instead, so throughput stays flat past saturation.
         self.backlog_fn = lambda: False
         self.shed = 0
+        self._backlogged = False  # last observed backpressure state
         spawn(self._run(), name="payload-maker")
 
     async def request_make(self) -> Payload:
@@ -63,7 +65,14 @@ class PayloadMaker:
         return payload
 
     async def _ingest(self, tx: Transaction) -> None:
-        if self.backlog_fn():
+        backlogged = self.backlog_fn()
+        if backlogged != self._backlogged or backlogged:
+            # Transitions land in the flight recorder; sustained pressure
+            # feeds the anomaly watchdog (the round-5 freeze signature:
+            # cold-lane egress pinned at capacity while rounds stall).
+            self._backlogged = backlogged
+            tracing.WATCHDOG.note_backpressure(backlogged)
+        if backlogged:
             self.shed += 1
             if self.shed % 10_000 == 1:
                 log.warning(

@@ -27,12 +27,14 @@ import struct
 from dataclasses import dataclass
 from typing import Callable
 
-from ..utils import metrics
+from ..utils import metrics, tracing
 from ..utils.actors import Selector, channel, spawn
 
 log = logging.getLogger("hotstuff.network")
 
 Address = tuple[str, int]
+
+_M_FRAMES_TAGGED = metrics.counter("trace.frames_tagged")
 
 _M_BYTES_SENT = metrics.counter("net.bytes_sent")
 _M_FRAMES_SENT = metrics.counter("net.frames_sent")
@@ -78,14 +80,24 @@ class NetMessage:
 
     `urgent` selects the hot egress lane: protocol-critical recovery
     traffic (payload sync requests/replies) that must not queue behind
-    bulk gossip. See NetSender."""
+    bulk gossip. See NetSender.
+
+    `trace` is an optional causal trace context (utils/tracing.py):
+    when set, the sender appends its 22-byte trailer INSIDE the frame
+    (counted by the length prefix, stripped by the receiver before the
+    codec) so the block's journey can be stitched across nodes.
+    Trailer-less peers and trailer-less frames interoperate unchanged."""
 
     data: bytes
     addresses: list[Address]
     urgent: bool = False
+    trace: "tracing.TraceContext | None" = None
 
 
-def frame(data: bytes) -> bytes:
+def frame(data: bytes, trace: "tracing.TraceContext | None" = None) -> bytes:
+    if trace is not None:
+        trailer = trace.trailer()
+        return struct.pack(">I", len(data) + len(trailer)) + data + trailer
     return struct.pack(">I", len(data)) + data
 
 
@@ -202,7 +214,14 @@ class NetSender:
     async def _run(self) -> None:
         while True:
             msg: NetMessage = await self._rx.get()
-            payload = frame(msg.data)
+            payload = frame(msg.data, msg.trace)
+            if msg.trace is not None:
+                _M_FRAMES_TAGGED.inc()
+                tracing.event(
+                    "net.send", msg.trace.trace_id,
+                    hop=msg.trace.hop, peers=len(msg.addresses),
+                    bytes=len(msg.data), urgent=msg.urgent,
+                )
             if self._transport is not None:
                 # Chaos seam: the transport owns delivery (and the faults).
                 for addr in msg.addresses:
@@ -352,6 +371,12 @@ class NetReceiver:
                 break
             _M_FRAMES_RECEIVED.inc()
             _M_BYTES_RECEIVED.inc(len(data) + 4)  # + length prefix
+            data, ctx = tracing.strip_trailer(data)
+            if ctx is not None:
+                tracing.note_received(ctx)
+                tracing.event(
+                    "net.recv", ctx.trace_id, hop=ctx.hop, bytes=len(data)
+                )
             try:
                 message = self._decode(data)
             except Exception as e:

@@ -168,6 +168,14 @@ def main(argv: list[str] | None = None) -> None:
         help="write the structured metrics dump (utils/metrics.py) to this "
         "path on exit/SIGTERM",
     )
+    p_run.add_argument(
+        "--trace-out",
+        default=None,
+        help="write the flight-recorder dump (utils/tracing.py) to this "
+        "path on exit/SIGTERM; anomaly-watchdog dumps land next to it as "
+        "<path>.watchdog-<reason>-<n>.json. HOTSTUFF_TRACE=0 disables "
+        "recording, HOTSTUFF_TRACE_RING sizes the ring",
+    )
 
     p_deploy = sub.add_parser("deploy", help="in-process local testbed")
     p_deploy.add_argument("--nodes", type=int, required=True)
@@ -228,6 +236,26 @@ def main(argv: list[str] | None = None) -> None:
                     )
 
             flushers.append(_write_metrics)
+        if args.trace_out:
+            from ..utils import tracing
+
+            # Label this process's events with the keys-file stem so
+            # multi-node dumps stitch with stable node names, and arm the
+            # anomaly watchdog's auto-dump next to the exit dump.
+            tracing.NODE_LABEL.set(os.path.splitext(
+                os.path.basename(args.keys)
+            )[0])
+            tracing.WATCHDOG.set_auto_dump(args.trace_out)
+
+            def _write_trace():
+                try:
+                    tracing.write_json(args.trace_out)
+                except OSError as e:
+                    logging.getLogger("hotstuff.tracing").warning(
+                        "failed to write trace dump: %r", e
+                    )
+
+            flushers.append(_write_trace)
 
     # HOTSTUFF_PROFILE=<path>: run the node under cProfile and dump stats
     # to <path>.<pid> on SIGTERM/exit (SURVEY §5.5 observability; used by

@@ -221,6 +221,10 @@ class Timer:
         self.reset()
 
     def reset(self) -> None:
+        from . import tracing
+
+        if tracing.enabled():
+            tracing.event("timer.arm", delay_ms=round(self._delay * 1000.0, 3))
         loop = asyncio.get_event_loop()
         self._deadline = loop.time() + self._delay
         # Wake pending waiters: an in-flight sleep targets the OLD deadline,
@@ -257,6 +261,9 @@ class Timer:
         while True:
             remaining = self._deadline - loop.time()
             if remaining <= self.RESOLUTION_S:
+                from . import tracing
+
+                tracing.event("timer.fire")
                 return
             if self._moved is None:
                 self._moved = asyncio.Event()

@@ -539,6 +539,13 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("chaos.restarts", "counter", None),
     ("chaos.invariant_checks", "counter", None),
     ("chaos.invariant_violations", "counter", None),
+    # utils/tracing.py — causal tracing + flight recorder
+    ("trace.events", "counter", None),
+    ("trace.dropped", "counter", None),
+    ("trace.dumps", "counter", None),
+    ("trace.watchdog_triggers", "counter", None),
+    ("trace.frames_tagged", "counter", None),
+    ("trace.frames_stripped", "counter", None),
 )
 
 

@@ -1,0 +1,582 @@
+"""Causal tracing + flight recorder for cross-node commit-latency attribution.
+
+PR 1's metrics are per-process AGGREGATES: they can say "commit latency
+p99 regressed" but not "where did block B spend its time across the
+committee" or "what was this node doing in the 5 s before the stall" —
+the round-5 liveness bug took six ad-hoc instrumented reruns to
+root-cause for exactly that reason. This module adds the three missing
+pieces:
+
+  * **Causal trace context** — `TraceContext(round, digest8, hop)`, a
+    compact 18-byte token identifying one block's journey. It rides an
+    optional TRAILER on the existing 4-byte-length network frames
+    (`network/net.py`): the trailer lives INSIDE the framed payload,
+    self-delimited by a magic suffix, so trailer-less frames (older
+    peers, tracing disabled) parse unchanged and trailered frames are
+    stripped before the codec sees them. The trace id is derivable from
+    protocol content (round + block-digest prefix), so every layer can
+    stamp events for a block WITHOUT threading a context object through
+    the actor channels; the trailer's job is the frame-level receive
+    stamp and the hop counter.
+
+  * **Flight recorder** — a process-global fixed-size ring buffer of
+    structured events (stage events, timer arms/fires, backpressure
+    transitions, chaos fault injections). Recording is one deque.append
+    under the GIL (no lock, O(1), oldest evicted by maxlen) and is gated
+    on a module flag exactly like `HOTSTUFF_METRICS=0`: disabled-mode
+    `event()` is a single global read and an early return. Dumps go to
+    JSON on demand, on exit/SIGTERM (`node run --trace-out`), and
+    automatically when the anomaly watchdog fires.
+
+  * **Anomaly watchdog** — fires a recorder dump when the protocol looks
+    wedged: a round stalled past N consecutive timeouts, a sustained
+    egress cold-lane backpressure window, or a verify-throughput
+    regression vs the run's own baseline. The dump then CONTAINS the
+    events leading up to the anomaly — a replayable artifact instead of
+    an instrumented rerun.
+
+Event times use a pluggable clock (default `time.monotonic`); the chaos
+runner points it at its virtual-time loop so recorded timelines match
+the deterministic replay. Dumps carry a (mono, wall) anchor pair so
+`tools/trace_report.py` can align rings from different processes.
+
+Canonical stage vocabulary: the six per-block lifecycle stages
+(`STAGES`) plus the auxiliary event kinds (`EVENT_KINDS`). Like the
+metric namespace, this is the schema of record — `tools/lint_metrics.py`
+fails any string-literal `tracing.event` kind that is not registered
+here.
+
+Dependency-free by design: stdlib + utils.metrics only (no jax, no
+asyncio import at module level).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import os
+import struct
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from . import metrics
+
+log = logging.getLogger("hotstuff.tracing")
+
+__all__ = [
+    "TraceContext",
+    "FlightRecorder",
+    "AnomalyWatchdog",
+    "RECORDER",
+    "WATCHDOG",
+    "NODE_LABEL",
+    "STAGES",
+    "EVENT_KINDS",
+    "TRAILER_MAGIC",
+    "TRAILER_SIZE",
+    "enabled",
+    "enable",
+    "set_clock",
+    "event",
+    "trace_id",
+    "context_for",
+    "note_received",
+    "strip_trailer",
+    "dump",
+    "write_json",
+    "reset",
+]
+
+# The six per-block lifecycle stages stitched into the commit-latency
+# breakdown (ISSUE order: proposal -> payload-fetch -> verify -> vote ->
+# QC-assembly -> commit).
+STAGES: tuple[str, ...] = (
+    "propose", "payload", "verify", "vote", "qc", "commit",
+)
+
+# Auxiliary event kinds the recorder accepts (everything `event()` may be
+# called with; the lint enforces literals against this set).
+EVENT_KINDS: frozenset[str] = frozenset(STAGES) | {
+    "net.send",
+    "net.recv",
+    "timer.arm",
+    "timer.fire",
+    "timeout",
+    "sync.request",
+    "sync.retry",
+    "payload.gossip",
+    "payload.stored",
+    "payload.served",
+    "verify.batch",
+    "backpressure.on",
+    "backpressure.off",
+    "chaos.fault",
+    "chaos.crash",
+    "chaos.restart",
+    "watchdog.round_stall",
+    "watchdog.verify_regression",
+    "watchdog.backpressure",
+    "dump",
+}
+
+_M_EVENTS = metrics.counter("trace.events")
+_M_DROPPED = metrics.counter("trace.dropped")
+_M_DUMPS = metrics.counter("trace.dumps")
+_M_TRIGGERS = metrics.counter("trace.watchdog_triggers")
+_M_FRAMES_STRIPPED = metrics.counter("trace.frames_stripped")
+
+_enabled = os.environ.get("HOTSTUFF_TRACE", "1") != "0"
+
+# Pluggable clock: production uses the monotonic clock; the chaos
+# orchestrator installs its virtual-time loop's `loop.time` so recorded
+# timelines follow the deterministic replay.
+_clock: Callable[[], float] = time.monotonic
+
+# Which logical node is executing (an index in the chaos runner, a name
+# in a real node process). Inherited by every task/thread spawned while
+# set, so one in-process recorder can attribute events per node.
+NODE_LABEL: contextvars.ContextVar[object | None] = contextvars.ContextVar(
+    "trace-node-label", default=None
+)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+def set_clock(fn: Callable[[], float] | None) -> Callable[[], float]:
+    """Install a clock for event timestamps; returns the previous one.
+    Pass None to restore the default monotonic clock."""
+    global _clock
+    prev, _clock = _clock, (fn or time.monotonic)
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# Trace context + frame trailer
+
+
+def trace_id(round_: int, digest: bytes) -> str:
+    """Canonical trace id for one block: round + 8-byte digest prefix.
+    Derivable anywhere the block (or its QC / a vote on it) is in hand."""
+    return f"r{round_}-{digest[:8].hex()}"
+
+
+# Trailer layout (appended INSIDE the 4-byte-length frame):
+#   [0x01 version][round u64 BE][digest prefix 8B][hop u8][4B magic]
+# Detection keys on the magic suffix + version byte: a trailer-less frame
+# whose payload happens to end with these 5 bytes misparses with
+# probability ~2^-40 per frame — accepted (the trailer is observability,
+# never a correctness dependency).
+TRAILER_MAGIC = b"\x9c\x54\x52\x31"  # \x9c 'TR1'
+_CTX = struct.Struct(">BQ8sB")
+TRAILER_SIZE = _CTX.size + len(TRAILER_MAGIC)  # 22 bytes
+
+
+class TraceContext:
+    """Compact causal token: (round, block-digest prefix, hop counter)."""
+
+    __slots__ = ("round", "digest8", "hop")
+
+    def __init__(self, round_: int, digest8: bytes, hop: int = 0) -> None:
+        self.round = round_
+        self.digest8 = bytes(digest8[:8]).ljust(8, b"\0")
+        self.hop = min(hop, 255)
+
+    @property
+    def trace_id(self) -> str:
+        return f"r{self.round}-{self.digest8.hex()}"
+
+    def encode(self) -> bytes:
+        return _CTX.pack(1, self.round, self.digest8, self.hop)
+
+    @staticmethod
+    def decode(data: bytes) -> "TraceContext":
+        ver, round_, digest8, hop = _CTX.unpack(data)
+        if ver != 1:
+            raise ValueError(f"unknown trace-context version {ver}")
+        return TraceContext(round_, digest8, hop)
+
+    def trailer(self) -> bytes:
+        return self.encode() + TRAILER_MAGIC
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id}, hop={self.hop})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, TraceContext)
+            and self.round == other.round
+            and self.digest8 == other.digest8
+            and self.hop == other.hop
+        )
+
+
+def strip_trailer(
+    data: bytes, count: bool = True
+) -> tuple[bytes, TraceContext | None]:
+    """Split one framed payload into (codec bytes, trace context or None).
+    Trailer-less frames pass through untouched, so trailer-enabled and
+    trailer-less peers interoperate in both directions. `count=False`
+    skips the inbound-frame counter (for send-side peeks — the chaos
+    transport strips for its adversary policies and re-appends)."""
+    if len(data) >= TRAILER_SIZE and data.endswith(TRAILER_MAGIC):
+        try:
+            ctx = TraceContext.decode(data[-TRAILER_SIZE:-len(TRAILER_MAGIC)])
+        except (ValueError, struct.error):
+            return data, None
+        if count:
+            _M_FRAMES_STRIPPED.inc()
+        return data[:-TRAILER_SIZE], ctx
+    return data, None
+
+
+# Received-hop memory: trace_id -> hop of the last inbound frame carrying
+# it, so a relayed message (vote for a received proposal) can extend the
+# causal chain instead of restarting it. Bounded insertion-ordered dict.
+_HOP_CAP = 1024
+_hops: dict[str, int] = {}
+_hops_lock = threading.Lock()
+
+
+def note_received(ctx: TraceContext) -> None:
+    """Record an inbound context (called by NetReceiver / the chaos
+    transport after stripping a trailer)."""
+    with _hops_lock:
+        _hops[ctx.trace_id] = ctx.hop
+        while len(_hops) > _HOP_CAP:
+            _hops.pop(next(iter(_hops)))
+
+
+def context_for(round_: int, digest: bytes) -> TraceContext:
+    """Context for an OUTBOUND message about block (round, digest): hop
+    extends the received chain when this node saw the block arrive, else
+    starts at 0 (this node originated it)."""
+    ctx = TraceContext(round_, digest)
+    with _hops_lock:
+        prev = _hops.get(ctx.trace_id)
+    if prev is not None:
+        ctx.hop = min(prev + 1, 255)
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+
+
+class FlightRecorder:
+    """Fixed-size ring of structured events.
+
+    Recording is a single `deque.append` (thread-safe under the GIL,
+    maxlen evicts the oldest) — cheap enough for per-frame and per-stage
+    stamping on the hot path. `dump()` snapshots the ring without
+    stopping writers (a torn tail of one in-flight event is acceptable
+    for a diagnostic artifact; a lock on the hot path is not)."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("HOTSTUFF_TRACE_RING", "16384"))
+            except ValueError:
+                capacity = 16384
+        self.capacity = max(16, capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._count = 0  # total ever recorded (dropped = count - len)
+
+    _USE_CTX = object()  # record(): default = read NODE_LABEL
+
+    def record(
+        self,
+        kind: str,
+        trace: str | None = None,
+        dur: float | None = None,
+        data: dict | None = None,
+        label: object = _USE_CTX,
+    ) -> None:
+        if not _enabled:
+            return
+        self._count += 1
+        _M_EVENTS.inc()
+        if self._count > self.capacity:
+            _M_DROPPED.inc()
+        if label is self._USE_CTX:
+            label = NODE_LABEL.get()
+        self._ring.append((_clock(), label, kind, trace, dur, data))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._count - self.capacity)
+
+    def events(self, node: object | None = None, limit: int | None = None) -> list[dict]:
+        """Snapshot as dicts, optionally filtered to one node label and
+        capped to the most recent `limit` events."""
+        out = []
+        for t, label, kind, trace, dur, data in list(self._ring):
+            if node is not None and label != node:
+                continue
+            e: dict = {"t": round(t, 6), "kind": kind}
+            if label is not None:
+                e["node"] = label
+            if trace is not None:
+                e["trace"] = trace
+            if dur is not None:
+                e["dur"] = round(dur, 6)
+            if data:
+                e["data"] = data
+            out.append(e)
+        if limit is not None and len(out) > limit:
+            out = out[-limit:]
+        return out
+
+    def dump(self, node: object | None = None) -> dict:
+        """Full structured artifact. The (mono, wall) anchor pair lets
+        `tools/trace_report.py` align rings dumped by different
+        processes onto one wall-clock timeline."""
+        _M_DUMPS.inc()
+        return {
+            "v": 1,
+            "enabled": _enabled,
+            "node": node if node is not None else NODE_LABEL.get(),
+            "capacity": self.capacity,
+            "recorded": self._count,
+            "dropped": self.dropped,
+            "anchor": {"mono": _clock(), "wall": time.time()},
+            "events": self.events(node=node),
+        }
+
+    def write_json(self, path: str, node: object | None = None) -> None:
+        with open(path, "w") as f:
+            json.dump(self.dump(node=node), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def reset(self) -> None:
+        self._ring.clear()
+        self._count = 0
+
+
+RECORDER = FlightRecorder()
+
+
+def event(
+    kind: str,
+    trace: str | None = None,
+    dur: float | None = None,
+    **data,
+) -> None:
+    """Record one event into the process flight recorder. Hot paths pass
+    positional (kind, trace, dur) only — the kwargs dict is for cold
+    sites. Disabled mode is a single global read + return."""
+    if not _enabled:
+        return
+    RECORDER.record(kind, trace, dur, data or None)
+
+
+def dump() -> dict:
+    return RECORDER.dump()
+
+
+def write_json(path: str) -> None:
+    RECORDER.write_json(path)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly watchdog
+
+
+class AnomalyWatchdog:
+    """Event-driven anomaly detector that triggers recorder dumps.
+
+    Layers feed it observations (no polling thread — it must work
+    unmodified under the chaos runner's virtual clock):
+
+      * `note_timeout(round, consecutive)` — consensus pacemaker firings;
+        `consecutive >= stall_timeouts` means the round is wedged beyond
+        the ordinary crash-fault view-change (2 per rotation).
+      * `note_backpressure(active)` — egress cold-lane backpressure
+        transitions from the payload maker; active for longer than
+        `backpressure_s` means gossip fan-out cannot reach a majority.
+      * `note_verify(dur_s, n)` — per-flush verification cost from the
+        BatchVerificationService; a sustained per-signature cost above
+        `p99_factor` x the run's own baseline (the MEDIAN of the first
+        BASELINE_SAMPLES flushes — cold-compile outliers must not poison
+        it) is a verify regression (device fell back to host, relay
+        degraded, ...).
+
+    Each reason fires at most once per `cooldown_s`; firing records a
+    `watchdog.<reason>` event and invokes every registered dump hook
+    with (reason, detail). `node/main.py` installs a file-writing hook
+    next to `--trace-out`; the chaos orchestrator captures dumps into
+    its report.
+    """
+
+    # note_verify: samples to average into the baseline, and consecutive
+    # regressed flushes required before firing (one slow flush is noise).
+    BASELINE_SAMPLES = 32
+    REGRESSION_STREAK = 8
+
+    def __init__(
+        self,
+        stall_timeouts: int | None = None,
+        backpressure_s: float | None = None,
+        p99_factor: float | None = None,
+        cooldown_s: float | None = None,
+    ) -> None:
+        env = os.environ.get
+        self.stall_timeouts = stall_timeouts if stall_timeouts is not None else int(
+            env("HOTSTUFF_TRACE_STALL_TIMEOUTS", "3")
+        )
+        self.backpressure_s = backpressure_s if backpressure_s is not None else float(
+            env("HOTSTUFF_TRACE_BACKPRESSURE_S", "5")
+        )
+        self.p99_factor = p99_factor if p99_factor is not None else float(
+            env("HOTSTUFF_TRACE_P99_FACTOR", "4")
+        )
+        self.cooldown_s = cooldown_s if cooldown_s is not None else float(
+            env("HOTSTUFF_TRACE_COOLDOWN_S", "30")
+        )
+        self._hooks: list[Callable[[str, dict], None]] = []
+        self._last_fired: dict[str, float] = {}
+        self._bp_since: float | None = None
+        self._verify_samples: list[float] = []
+        self._verify_baseline: float | None = None
+        self._verify_streak = 0
+        self.triggers: list[dict] = []
+
+    # -- hooks ---------------------------------------------------------------
+
+    def add_dump_hook(self, fn: Callable[[str, dict], None]) -> None:
+        self._hooks.append(fn)
+
+    def remove_dump_hook(self, fn: Callable[[str, dict], None]) -> None:
+        try:
+            self._hooks.remove(fn)
+        except ValueError:
+            pass
+
+    def set_auto_dump(self, path_prefix: str) -> Callable[[str, dict], None]:
+        """Install (and return) a hook writing `<prefix>.watchdog-<reason>-<n>.json`
+        per trigger."""
+        seq = {"n": 0}
+
+        def _write(reason: str, detail: dict) -> None:
+            seq["n"] += 1
+            path = f"{path_prefix}.watchdog-{reason}-{seq['n']}.json"
+            try:
+                d = RECORDER.dump()
+                d["watchdog"] = {"reason": reason, **detail}
+                with open(path, "w") as f:
+                    json.dump(d, f, indent=2, sort_keys=True)
+                    f.write("\n")
+                log.warning("watchdog %s: flight recorder dumped to %s", reason, path)
+            except OSError as e:
+                log.warning("watchdog %s: dump failed: %r", reason, e)
+
+        self.add_dump_hook(_write)
+        return _write
+
+    def _trigger(self, reason: str, **detail) -> None:
+        now = _clock()
+        last = self._last_fired.get(reason)
+        if last is not None and now - last < self.cooldown_s:
+            return
+        self._last_fired[reason] = now
+        _M_TRIGGERS.inc()
+        RECORDER.record(f"watchdog.{reason}", None, None, detail or None)
+        self.triggers.append({"t": round(now, 6), "reason": reason, **detail})
+        log.warning("anomaly watchdog fired: %s %s", reason, detail)
+        for hook in list(self._hooks):
+            try:
+                hook(reason, detail)
+            except Exception as e:
+                log.warning("watchdog hook failed: %r", e)
+
+    # -- observations --------------------------------------------------------
+
+    def note_timeout(self, round_: int, consecutive: int) -> None:
+        if not _enabled:
+            return
+        if consecutive >= self.stall_timeouts:
+            self._trigger("round_stall", round=round_, consecutive=consecutive)
+        # A stall is also the moment to check whether backpressure has
+        # been pinning the egress plane (the round-5 freeze signature:
+        # stalled rounds WITH a saturated cold lane).
+        if self._bp_since is not None:
+            self.note_backpressure(True)
+
+    def note_backpressure(self, active: bool) -> None:
+        if not _enabled:
+            return
+        now = _clock()
+        if active:
+            if self._bp_since is None:
+                self._bp_since = now
+                RECORDER.record("backpressure.on", None, None, None)
+            elif now - self._bp_since >= self.backpressure_s:
+                self._trigger(
+                    "backpressure",
+                    sustained_s=round(now - self._bp_since, 3),
+                )
+        elif self._bp_since is not None:
+            RECORDER.record(
+                "backpressure.off", None, None,
+                {"sustained_s": round(now - self._bp_since, 3)},
+            )
+            self._bp_since = None
+
+    def note_verify(self, dur_s: float, n: int) -> None:
+        if not _enabled or n <= 0:
+            return
+        per_sig = dur_s / n
+        if self._verify_baseline is None:
+            # Median, not mean: the first flushes include multi-second
+            # XLA compiles on the device path — a mean baseline would sit
+            # orders of magnitude above warm cost and the regression
+            # trigger would never fire for exactly the runs it exists for.
+            self._verify_samples.append(per_sig)
+            if len(self._verify_samples) >= self.BASELINE_SAMPLES:
+                ordered = sorted(self._verify_samples)
+                self._verify_baseline = ordered[len(ordered) // 2]
+                self._verify_samples = []
+            return
+        baseline = self._verify_baseline
+        if baseline > 0 and per_sig > self.p99_factor * baseline:
+            self._verify_streak += 1
+            if self._verify_streak >= self.REGRESSION_STREAK:
+                self._verify_streak = 0
+                self._trigger(
+                    "verify_regression",
+                    per_sig_s=round(per_sig, 9),
+                    baseline_s=round(baseline, 9),
+                )
+        else:
+            self._verify_streak = 0
+
+    def reset(self) -> None:
+        self._last_fired.clear()
+        self._bp_since = None
+        self._verify_samples = []
+        self._verify_baseline = None
+        self._verify_streak = 0
+        self.triggers = []
+
+
+WATCHDOG = AnomalyWatchdog()
+
+
+def reset() -> None:
+    """Clear recorder, hop memory, and watchdog state (test isolation)."""
+    RECORDER.reset()
+    WATCHDOG.reset()
+    with _hops_lock:
+        _hops.clear()

@@ -222,6 +222,27 @@ def test_log_parser_reports_workload_shed():
     assert "Workload shed at saturation: >= 200,390 sigs" in p.result()
 
 
+def test_log_parser_surfaces_watchdog_firings():
+    """Anomaly-watchdog WARNING lines (utils/tracing.py) surface as a
+    summary warning with reasons and dump count; absent when quiet."""
+    from benchmark.logs import LogParser
+
+    assert "anomaly watchdog" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node = NODE_LOG + (
+        "[2026-07-30T10:00:05.000Z WARNING hotstuff.tracing] anomaly "
+        "watchdog fired: round_stall {'round': 9, 'consecutive': 3}\n"
+        "[2026-07-30T10:00:05.001Z WARNING hotstuff.tracing] watchdog "
+        "round_stall: flight recorder dumped to /tmp/n0.trace.json."
+        "watchdog-round_stall-1.json\n"
+    )
+    p = LogParser([CLIENT_LOG], [node])
+    assert p.watchdog_fired == ["round_stall"]
+    assert len(p.watchdog_dumps) == 1
+    out = p.result()
+    assert "anomaly watchdog fired 1x (round_stall)" in out
+    assert "1 recorder dump(s)" in out
+
+
 # ---------------------------------------------------------------------------
 # LogParser: METRICS snapshot scraping (utils/metrics.py periodic emitter)
 
@@ -343,12 +364,21 @@ def test_chaos_run_cli_smoke(tmp_path):
         "safety_violations",
         "liveness_violations",
         "metrics",
+        "flight_recorders",
+        "watchdog_dumps",
         "ok",
     ):
         assert key in report, key
     assert report["ok"] is True
     assert report["scenario"] == "baseline"
     assert all(len(c) >= 1 for c in report["commits"].values())
+    # per-node flight-recorder dumps are embedded: every node recorded
+    # stage events, so a failed scenario is diagnosable from the report
+    recorders = report["flight_recorders"]
+    assert sorted(recorders) == ["0", "1", "2", "3"]
+    assert all(
+        any(e["kind"] == "commit" for e in evs) for evs in recorders.values()
+    )
 
 
 def test_chaos_run_cli_rejects_unknown_scenario(tmp_path):
@@ -368,3 +398,141 @@ def test_chaos_run_cli_rejects_unknown_scenario(tmp_path):
     )
     assert proc.returncode == 3
     assert "unknown scenario" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# bench.py graceful degradation: with the axon relay unreachable it must
+# exit rc 0 with a parseable JSON body carrying backend/error fields
+# (PR 1's contract; BENCH_r05.json regressed to rc=1/parsed=null because
+# the round-5 bench sys.exit()ed on the relay probe).
+
+
+def test_bench_degrades_to_rc0_json_when_relay_unreachable(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    # Relay env as the driver sees it: pool IPs set, platform unset, and
+    # nothing listening on the relay port -> the probe must fail fast and
+    # bench must fall back, not crash.
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+            "--batch", "64", "--device-batch", "32", "--chunk", "32",
+            "--iters", "1", "--e2e-iters", "1", "--cpu-budget", "0.1",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        env=env,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    body = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert body["metric"] == "votes_verified_per_sec"
+    assert "backend" in body
+    # degraded runs carry the diagnosis: either the relay error rode the
+    # cpu-fallback path, or a missing host dep surfaced as backend=error
+    assert body["backend"] in ("cpu-fallback", "error") or "error" in body
+    if body["backend"] != "cpu-fallback":
+        assert body.get("error")
+
+
+# ---------------------------------------------------------------------------
+# tools/lint_metrics.py: the metric/trace namespace lint
+
+
+_LINT = os.path.join(os.path.dirname(__file__), "..", "tools", "lint_metrics.py")
+
+
+def test_lint_metrics_passes_on_repo():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, _LINT],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "clean" in proc.stdout
+
+
+def test_lint_metrics_flags_unregistered_names(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "rogue.py"
+    bad.write_text(
+        "from hotstuff_tpu.utils import metrics, tracing\n"
+        'C = metrics.counter("rogue.metric_name")\n'
+        'tracing.event("rogue.stage")\n'
+    )
+    proc = subprocess.run(
+        [sys.executable, _LINT, "--root", str(tmp_path)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "rogue.metric_name" in proc.stderr
+    assert "rogue.stage" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# tools/metrics_report.py: chaos reports render flight-recorder sections
+
+
+def test_metrics_report_renders_chaos_flight_recorders():
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import metrics_report
+
+    chaos_report = {
+        "counters": {"chaos.drops": 7},
+        "histograms": {},
+        "flight_recorders": {
+            "0": [
+                {"t": 1.0, "kind": "commit", "trace": "r1-aa", "node": 0},
+                {"t": 1.5, "kind": "timeout", "node": 0},
+            ],
+            "1": [{"t": 1.1, "kind": "commit", "trace": "r1-aa", "node": 1}],
+        },
+        "watchdog_triggers": [
+            {"t": 2.0, "reason": "round_stall", "round": 9, "consecutive": 3}
+        ],
+        "watchdog_dumps": [{"reason": "round_stall", "events": []}],
+    }
+    out = metrics_report.report(chaos_report)
+    assert "Flight recorders" in out
+    assert "| 0 | 2 |" in out
+    assert "round_stall" in out
+    assert "chaos.drops" in out
+
+
+def test_metrics_report_load_accepts_chaos_report(tmp_path):
+    import json
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    import metrics_report
+
+    path = tmp_path / "chaos.json"
+    path.write_text(json.dumps({
+        "metrics": {"chaos.crashes": 1},
+        "flight_recorders": {"0": []},
+        "ok": True,
+    }))
+    d = metrics_report._load(str(path))
+    assert d["counters"] == {"chaos.crashes": 1}
+    assert "flight_recorders" in d

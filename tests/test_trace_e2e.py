@@ -1,0 +1,151 @@
+"""Acceptance tests for cross-node commit-latency attribution: a 4-node
+run (real consensus/crypto/network stack on the deterministic virtual-
+time loop) produces per-node flight-recorder dumps that
+`tools/trace_report.py` stitches into (a) a per-block latency breakdown
+covering all six lifecycle stages on every honest node and (b) a valid
+Chrome `trace_event` JSON; and an induced round stall (chaos
+`leader_crash`) auto-triggers an anomaly-watchdog recorder dump carrying
+the timeout events leading up to it.
+
+Dependency-free (pure-python signer, no sockets); `chaos` marker like
+the other scenario tests."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from hotstuff_tpu.chaos.scenarios import run_scenario
+from hotstuff_tpu.utils import tracing
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import trace_report  # noqa: E402
+
+pytestmark = pytest.mark.chaos
+
+STAGES = trace_report.STAGES
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset()
+    yield
+    tracing.reset()
+
+
+def _stitch(report):
+    nodes = [
+        {"node": label, "offset": 0.0, "events": events}
+        for label, events in sorted(report["flight_recorders"].items())
+    ]
+    return nodes, trace_report.stage_times(nodes)
+
+
+def test_four_node_run_stitches_all_six_stages_per_node(tmp_path):
+    report = run_scenario("baseline", seed=1)
+    assert report["ok"], report
+    recorders = report["flight_recorders"]
+    assert sorted(recorders) == ["0", "1", "2", "3"]
+    assert all(recorders[n] for n in recorders), "every node recorded events"
+
+    nodes, blocks = _stitch(report)
+    # at least one committed block carries ALL six stages on ALL 4 nodes
+    full = [
+        t
+        for t, per_node in blocks.items()
+        if len(per_node) == 4
+        and all(set(STAGES) <= set(ts) for ts in per_node.values())
+    ]
+    assert full, f"no block with full 6-stage coverage: {list(blocks)[:5]}"
+
+    # the markdown breakdown renders those blocks with full coverage
+    table = trace_report.latency_table(blocks)
+    assert "Per-block commit latency" in table
+    assert all(stage in table for stage in STAGES)
+    assert any("4/4" in line for line in table.splitlines())
+
+    # and the same dumps produce a valid Chrome trace_event JSON
+    chrome = trace_report.chrome_trace(nodes)
+    events = chrome["traceEvents"]
+    assert events
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert "pid" in e and "name" in e
+        if e["ph"] != "M":
+            assert e["ts"] >= 0.0
+    assert {e["pid"] for e in events} == {0, 1, 2, 3}
+    # round-trips through the CLI too (file inputs, --chrome output)
+    report_path = tmp_path / "chaos.json"
+    report_path.write_text(json.dumps(report))
+    chrome_path = tmp_path / "timeline.json"
+    rc = trace_report.main([str(report_path), "--chrome", str(chrome_path)])
+    assert rc == 0
+    loaded = json.loads(chrome_path.read_text())
+    assert loaded["traceEvents"]
+
+
+def test_per_node_dump_files_stitch_like_the_chaos_report(tmp_path):
+    """The real multi-process workflow: one dump FILE per node (what
+    `node run --trace-out` writes), stitched via anchor alignment."""
+    report = run_scenario("baseline", seed=3)
+    paths = []
+    for label, events in report["flight_recorders"].items():
+        p = tmp_path / f"node-{label}.trace.json"
+        p.write_text(json.dumps({
+            "v": 1,
+            "node": label,
+            "anchor": {"mono": 100.0, "wall": 5000.0},
+            "events": events,
+        }))
+        paths.append(str(p))
+    nodes = trace_report.load_inputs(paths)
+    assert len(nodes) == 4
+    assert all(rec["offset"] == 4900.0 for rec in nodes)
+    blocks = trace_report.stage_times(nodes)
+    assert blocks
+    table = trace_report.latency_table(blocks)
+    assert "commit" in table
+
+
+def test_leader_crash_stall_auto_triggers_recorder_dump():
+    """The acceptance scenario: node 1's crash wedges its leader rounds;
+    once consecutive timeouts cross the stall threshold the watchdog
+    fires DURING the run and embeds a recorder dump whose tail shows the
+    timeouts leading up to the stall."""
+    prev = tracing.WATCHDOG.stall_timeouts
+    # A single crashed leader inherently produces 2 consecutive timeouts
+    # per rotation (see consensus/core.py); threshold 2 makes that the
+    # induced stall. Production default (3) only fires on longer chains.
+    tracing.WATCHDOG.stall_timeouts = 2
+    try:
+        report = run_scenario("leader_crash", seed=11)
+    finally:
+        tracing.WATCHDOG.stall_timeouts = prev
+    assert report["ok"], report
+    triggers = report["watchdog_triggers"]
+    assert any(t["reason"] == "round_stall" for t in triggers), triggers
+    dumps = report["watchdog_dumps"]
+    assert dumps, "watchdog fired but no recorder dump was captured"
+    d = dumps[0]
+    assert d["reason"] == "round_stall"
+    timeouts = [e for e in d["events"] if e["kind"] == "timeout"]
+    assert timeouts, "dump must contain the timeout events before the stall"
+    # the timeouts precede the trigger instant, i.e. they LED UP to it
+    assert all(e["t"] <= d["t"] for e in timeouts)
+    # the stall was induced by the crash: the dump shows the fault events
+    assert any(e["kind"] in ("chaos.crash", "chaos.fault") for e in d["events"])
+
+
+def test_trace_disabled_run_stays_clean():
+    """HOTSTUFF_TRACE=0 equivalent: with recording off, a scenario still
+    passes and the report embeds empty recorder sections — the disabled
+    fast path costs nothing and breaks nothing."""
+    tracing.enable(False)
+    try:
+        report = run_scenario("baseline", seed=2)
+    finally:
+        tracing.enable(True)
+    assert report["ok"], report
+    assert all(not evs for evs in report["flight_recorders"].values())
+    assert report["watchdog_dumps"] == []

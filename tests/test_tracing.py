@@ -1,0 +1,291 @@
+"""Unit tests for the causal-tracing subsystem (utils/tracing.py):
+context encode/decode, frame-trailer compatibility in both directions
+over FrameReader, ring-buffer wraparound, the disabled-mode fast path
+(mirroring HOTSTUFF_METRICS=0), hop-chain memory, and the anomaly
+watchdog. Dependency-free: no jax, no `cryptography`."""
+
+import asyncio
+import json
+
+import pytest
+
+from hotstuff_tpu.network.net import FrameReader, NetMessage, NetReceiver, NetSender, frame
+from hotstuff_tpu.utils import metrics, tracing
+from hotstuff_tpu.utils.actors import channel
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset()
+    tracing.enable(True)
+    yield
+    tracing.reset()
+    tracing.enable(True)
+
+
+# ---------------------------------------------------------------------------
+# TraceContext + trailer
+
+
+def test_context_encode_decode_round_trip():
+    ctx = tracing.TraceContext(123456789, bytes(range(8)), 42)
+    out = tracing.TraceContext.decode(ctx.encode())
+    assert out == ctx
+    assert out.trace_id == f"r123456789-{bytes(range(8)).hex()}"
+
+
+def test_context_clamps_hop_and_pads_digest():
+    ctx = tracing.TraceContext(1, b"ab", 9000)
+    assert ctx.hop == 255
+    assert len(ctx.digest8) == 8
+    assert tracing.TraceContext.decode(ctx.encode()) == ctx
+
+
+def test_strip_trailer_both_directions():
+    ctx = tracing.TraceContext(7, b"DIGEST00", 2)
+    # trailer-enabled frame -> stripped payload + context
+    data, got = tracing.strip_trailer(b"payload-bytes" + ctx.trailer())
+    assert data == b"payload-bytes" and got == ctx
+    # trailer-less frame -> passes through untouched
+    data, got = tracing.strip_trailer(b"payload-bytes")
+    assert data == b"payload-bytes" and got is None
+    # short frames can never be misparsed
+    data, got = tracing.strip_trailer(b"")
+    assert data == b"" and got is None
+
+
+def test_trailer_with_corrupt_context_is_left_intact():
+    """A magic-suffixed frame whose context bytes are invalid (wrong
+    version) must not be truncated — the codec sees the original bytes."""
+    bad = b"x" * 12 + b"\x07" + bytes(17) + tracing.TRAILER_MAGIC
+    data, got = tracing.strip_trailer(bad)
+    assert got is None and data == bad
+
+
+def _feed_reader(*frames: bytes) -> asyncio.StreamReader:
+    reader = asyncio.StreamReader()
+    for f in frames:
+        reader.feed_data(f)
+    reader.feed_eof()
+    return reader
+
+
+def test_frame_reader_interop_trailered_and_plain(run_async):
+    """One TCP stream mixing trailer-enabled and trailer-less frames
+    parses cleanly in both directions: FrameReader yields each frame
+    whole (trailer inside the length prefix) and strip_trailer recovers
+    exactly the codec bytes + context."""
+
+    async def body():
+        ctx = tracing.TraceContext(5, b"BLOCKDIG", 1)
+        reader = _feed_reader(
+            frame(b"plain-one"),
+            frame(b"traced", ctx),
+            frame(b"plain-two"),
+        )
+        frames = FrameReader(reader)
+        out = []
+        while True:
+            data = await frames.next_frame()
+            if data is None:
+                break
+            out.append(tracing.strip_trailer(data))
+        assert out == [
+            (b"plain-one", None),
+            (b"traced", ctx),
+            (b"plain-two", None),
+        ]
+
+    run_async(body())
+
+
+def test_net_receiver_strips_trailer_before_decode(run_async, base_port):
+    """Trailer-enabled sender -> receiver whose decode asserts it never
+    sees trace bytes; and a trailer-less sender over the same socket path
+    still delivers (the compatibility contract end-to-end)."""
+
+    async def body():
+        addr = ("127.0.0.1", base_port)
+        delivered = channel()
+
+        def decode(data: bytes) -> bytes:
+            assert not data.endswith(tracing.TRAILER_MAGIC)
+            return data
+
+        NetReceiver(addr, delivered, decode=decode)
+        await asyncio.sleep(0.05)
+        tx = channel()
+        NetSender(tx)
+        ctx = tracing.TraceContext(9, b"ABCDEFGH", 0)
+        await tx.put(NetMessage(b"traced-msg", [addr], trace=ctx))
+        await tx.put(NetMessage(b"plain-msg", [addr]))
+        assert await asyncio.wait_for(delivered.get(), 5.0) == b"traced-msg"
+        assert await asyncio.wait_for(delivered.get(), 5.0) == b"plain-msg"
+        # the receive stamp landed in the flight recorder with the hop
+        recv = [
+            e for e in tracing.RECORDER.events() if e["kind"] == "net.recv"
+        ]
+        assert recv and recv[0]["trace"] == ctx.trace_id
+
+    run_async(body())
+
+
+def test_hop_chain_extends_on_relay():
+    ctx = tracing.TraceContext(3, b"12345678", 4)
+    tracing.note_received(ctx)
+    out = tracing.context_for(3, b"12345678-rest-of-digest")
+    assert out.hop == 5
+    # an unseen block starts a fresh chain
+    fresh = tracing.context_for(3, b"87654321")
+    assert fresh.hop == 0
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+
+
+def test_ring_buffer_wraparound():
+    r = tracing.FlightRecorder(capacity=32)
+    for i in range(100):
+        r.record("commit", f"r{i}-0000000000000000")
+    assert len(r) == 32
+    assert r.dropped == 68
+    events = r.events()
+    assert [e["trace"] for e in events] == [
+        f"r{i}-0000000000000000" for i in range(68, 100)
+    ]
+    d = r.dump()
+    assert d["recorded"] == 100 and d["dropped"] == 68
+    assert "mono" in d["anchor"] and "wall" in d["anchor"]
+
+
+def test_event_filter_by_node_label():
+    r = tracing.FlightRecorder(capacity=64)
+    tok = tracing.NODE_LABEL.set("n1")
+    try:
+        r.record("vote", "r1-aaaaaaaaaaaaaaaa")
+    finally:
+        tracing.NODE_LABEL.reset(tok)
+    r.record("vote", "r1-bbbbbbbbbbbbbbbb", label="n2")
+    r.record("timeout")
+    assert [e["trace"] for e in r.events(node="n1")] == ["r1-aaaaaaaaaaaaaaaa"]
+    assert [e["trace"] for e in r.events(node="n2")] == ["r1-bbbbbbbbbbbbbbbb"]
+    assert len(r.events()) == 3
+
+
+def test_disabled_mode_records_nothing():
+    """HOTSTUFF_TRACE=0 semantics: event() is a global read + return —
+    the ring stays empty, counters stay flat, the watchdog stays inert
+    (mirrors the HOTSTUFF_METRICS=0 fast path)."""
+    ring_before = len(tracing.RECORDER)
+    events_before = metrics.counter("trace.events").value
+    tracing.enable(False)
+    try:
+        for _ in range(100):
+            tracing.event("vote", "r1-cccccccccccccccc")
+        tracing.WATCHDOG.note_timeout(5, 99)
+        tracing.WATCHDOG.note_backpressure(True)
+    finally:
+        tracing.enable(True)
+    assert len(tracing.RECORDER) == ring_before
+    assert metrics.counter("trace.events").value == events_before
+    assert tracing.WATCHDOG.triggers == []
+
+
+def test_write_json_round_trips(tmp_path):
+    tracing.event("commit", "r2-dddddddddddddddd", 0.5, round=2)
+    path = tmp_path / "trace.json"
+    tracing.write_json(str(path))
+    d = json.loads(path.read_text())
+    assert d["v"] == 1
+    evs = [e for e in d["events"] if e["kind"] == "commit"]
+    assert evs and evs[0]["dur"] == 0.5 and evs[0]["data"]["round"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Anomaly watchdog
+
+
+def _clocked_watchdog(**kw):
+    now = {"t": 0.0}
+    prev = tracing.set_clock(lambda: now["t"])
+    wd = tracing.AnomalyWatchdog(**kw)
+    return wd, now, prev
+
+
+def test_watchdog_round_stall_trigger_and_cooldown():
+    wd, now, prev = _clocked_watchdog(stall_timeouts=3, cooldown_s=10.0)
+    try:
+        fired = []
+        wd.add_dump_hook(lambda reason, detail: fired.append((reason, detail)))
+        wd.note_timeout(4, 1)
+        wd.note_timeout(4, 2)
+        assert fired == []
+        wd.note_timeout(4, 3)
+        assert fired == [("round_stall", {"round": 4, "consecutive": 3})]
+        # inside the cooldown: no re-fire
+        now["t"] = 5.0
+        wd.note_timeout(5, 4)
+        assert len(fired) == 1
+        # past the cooldown: fires again
+        now["t"] = 20.0
+        wd.note_timeout(6, 3)
+        assert len(fired) == 2
+    finally:
+        tracing.set_clock(prev)
+
+
+def test_watchdog_sustained_backpressure():
+    wd, now, prev = _clocked_watchdog(backpressure_s=5.0, cooldown_s=100.0)
+    try:
+        fired = []
+        wd.add_dump_hook(lambda reason, detail: fired.append(reason))
+        wd.note_backpressure(True)  # transition on
+        now["t"] = 3.0
+        wd.note_backpressure(True)  # sustained 3s < 5s
+        assert fired == []
+        now["t"] = 4.0
+        wd.note_backpressure(False)  # released: window resets
+        now["t"] = 10.0
+        wd.note_backpressure(True)
+        now["t"] = 16.0
+        wd.note_backpressure(True)  # sustained 6s >= 5s
+        assert fired == ["backpressure"]
+        kinds = [e["kind"] for e in tracing.RECORDER.events()]
+        assert "backpressure.on" in kinds and "backpressure.off" in kinds
+    finally:
+        tracing.set_clock(prev)
+
+
+def test_watchdog_verify_regression():
+    wd, _now, prev = _clocked_watchdog(p99_factor=4.0, cooldown_s=100.0)
+    try:
+        fired = []
+        wd.add_dump_hook(lambda reason, detail: fired.append((reason, detail)))
+        for _ in range(wd.BASELINE_SAMPLES):
+            wd.note_verify(0.001, 10)  # 100 us/sig baseline
+        # a single slow flush is noise
+        wd.note_verify(0.1, 10)
+        assert fired == []
+        wd._verify_streak = 0
+        for _ in range(wd.REGRESSION_STREAK):
+            wd.note_verify(0.1, 10)  # 10 ms/sig, 100x baseline
+        assert len(fired) == 1 and fired[0][0] == "verify_regression"
+    finally:
+        tracing.set_clock(prev)
+
+
+def test_watchdog_auto_dump_writes_file(tmp_path):
+    wd, _now, prev = _clocked_watchdog(stall_timeouts=2, cooldown_s=0.0)
+    try:
+        prefix = str(tmp_path / "node.trace.json")
+        wd.set_auto_dump(prefix)
+        tracing.event("timeout", round=9)
+        wd.note_timeout(9, 2)
+        path = tmp_path / "node.trace.json.watchdog-round_stall-1.json"
+        assert path.exists()
+        d = json.loads(path.read_text())
+        assert d["watchdog"]["reason"] == "round_stall"
+        assert any(e["kind"] == "timeout" for e in d["events"])
+    finally:
+        tracing.set_clock(prev)

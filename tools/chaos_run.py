@@ -7,9 +7,12 @@
 Runs one named scenario (or `--scenario all` for the short library) from
 hotstuff_tpu.chaos.scenarios on the deterministic virtual-time loop and
 writes a JSON report: fault trace, per-node commit sequences, invariant
-violations, chaos.* metric deltas, and an overall `ok` flag. The same
+violations, chaos.* metric deltas, per-node flight-recorder dumps
+(`flight_recorders` — stitch with tools/trace_report.py), any
+anomaly-watchdog triggers/dumps, and an overall `ok` flag. The same
 --seed replays the identical fault trace and honest commit sequence, so a
-failing run's seed IS its reproducer.
+failing run's seed IS its reproducer, and a failed scenario is
+diagnosable from the report alone (tools/metrics_report.py renders it).
 
 Exit codes: 0 = every invariant and expectation held; 2 = violations
 (report still written); 3 = usage error.
@@ -93,6 +96,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"  LIVENESS: {v}")
         for v in report.get("expectation_failures", ()):
             print(f"  EXPECT: {v}")
+        for t in report.get("watchdog_triggers", ()):
+            # Anomaly-triggered flight-recorder dumps are embedded in the
+            # report (`watchdog_dumps`); tools/trace_report.py stitches
+            # the per-node `flight_recorders` sections.
+            print(f"  WATCHDOG: {t['reason']} at t={t['t']}")
 
     out = reports[0] if len(reports) == 1 else {
         "seed": args.seed,

@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Metric / trace namespace lint.
+
+    python tools/lint_metrics.py            # scan hotstuff_tpu/
+    python tools/lint_metrics.py --root DIR # scan an arbitrary tree
+
+Scans every Python file for string-literal metric registrations
+(`metrics.counter("…")` / `gauge` / `histogram`, and the module-local
+`counter("…")` forms) and flight-recorder stamps (`tracing.event("…")` /
+`RECORDER.record("…")`), and fails (rc 1) if any name is missing from
+the canonical schema:
+
+  * metrics  -> `hotstuff_tpu.utils.metrics._DEFAULT_NAMESPACE`
+  * tracing  -> `hotstuff_tpu.utils.tracing.EVENT_KINDS`
+
+This keeps `metrics.dump()`'s full-schema guarantee honest as layers
+grow (a dump must carry EVERY name, zeros included — a name registered
+only at a call site would appear in some processes and not others), and
+keeps the trace-stage vocabulary stable for `tools/trace_report.py`.
+
+Exit codes: 0 = clean, 1 = unknown names found, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_METRIC_CALL = re.compile(
+    r"""(?:metrics\s*\.\s*|\br\s*\.\s*|^\s*)              # metrics. / r. / bare
+        (counter|gauge|histogram)\s*\(\s*["']([^"']+)["']""",
+    re.VERBOSE | re.MULTILINE,
+)
+# f-strings are skipped (a dynamic kind is the caller's responsibility
+# to keep inside the canonical vocabulary, e.g. the watchdog's
+# `watchdog.<reason>` family).
+_TRACE_CALL = re.compile(
+    r"""(?:tracing\s*\.\s*event|\bevent|RECORDER\s*\.\s*record|\br\s*\.\s*record|self\s*\.\s*record)
+        \s*\(\s*\n?\s*(?<![fF])["']([^"'{}]+)["']""",
+    re.VERBOSE,
+)
+
+
+def scan_file(path: str, metric_names: set, trace_kinds: set) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    problems = []
+    for kind, name in _METRIC_CALL.findall(text):
+        if name not in metric_names:
+            problems.append(
+                f"{path}: {kind}({name!r}) not in metrics._DEFAULT_NAMESPACE"
+            )
+    for kind in _TRACE_CALL.findall(text):
+        if kind and kind not in trace_kinds:
+            problems.append(
+                f"{path}: trace event {kind!r} not in tracing.EVENT_KINDS"
+            )
+    return problems
+
+
+def run(root: str) -> list[str]:
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+    from hotstuff_tpu.utils.tracing import EVENT_KINDS
+
+    metric_names = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
+    problems: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            problems += scan_file(
+                os.path.join(dirpath, fn), metric_names, EVENT_KINDS
+            )
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="lint_metrics", description=__doc__)
+    ap.add_argument(
+        "--root",
+        default=os.path.join(os.path.dirname(__file__), "..", "hotstuff_tpu"),
+        help="tree to scan (default: hotstuff_tpu/)",
+    )
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"not a directory: {args.root}", file=sys.stderr)
+        return 2
+    problems = run(args.root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(
+            f"{len(problems)} unregistered metric/trace name(s); add them to "
+            "the canonical namespace (utils/metrics._DEFAULT_NAMESPACE / "
+            "utils/tracing.EVENT_KINDS) or fix the call site",
+            file=sys.stderr,
+        )
+        return 1
+    print("metric/trace namespace clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,10 +3,17 @@
 Usage:
     python tools/metrics_report.py METRICS.json            # one run
     python tools/metrics_report.py BEFORE.json AFTER.json  # before/after
+    python tools/metrics_report.py chaos.json              # chaos report
 
 Renders markdown tables (counters, then histogram summaries) for pasting
 into PR descriptions; with two files, adds delta columns so a perf PR's
 before/after is a diff of committed numbers, not prose.
+
+A chaos report (`tools/chaos_run.py --report`) is accepted too: its
+metric DELTAS render as the counter table, and its embedded per-node
+flight-recorder dumps and anomaly-watchdog triggers render as a
+"Flight recorders" section — a failed scenario is diagnosable from the
+report alone.
 """
 
 from __future__ import annotations
@@ -39,6 +46,21 @@ def _delta(old, new) -> str:
 def _load(path: str) -> dict:
     with open(path) as f:
         d = json.load(f)
+    if isinstance(d, dict) and "flight_recorders" in d and "counters" not in d:
+        # A chaos report: metric deltas play the counter role, recorder
+        # dumps ride along for the flight-recorder section.
+        return {
+            "counters": d.get("metrics", {}),
+            "histograms": {},
+            "flight_recorders": d.get("flight_recorders", {}),
+            "watchdog_dumps": d.get("watchdog_dumps", []),
+            "watchdog_triggers": d.get("watchdog_triggers", []),
+        }
+    if isinstance(d, dict) and "scenarios" in d and "counters" not in d:
+        sys.exit(
+            f"{path}: multi-scenario chaos sweep; re-run tools/chaos_run.py "
+            "with a single --scenario for a renderable report"
+        )
     if not isinstance(d, dict) or "counters" not in d:
         sys.exit(f"{path}: not a metrics dump (missing 'counters')")
     return d
@@ -100,6 +122,39 @@ def report(before: dict, after: dict | None = None, skip_zero: bool = True) -> s
                 "p99 (b/a) | p50 delta |\n|---|---|---|---|---|---|"
             )
         out.extend(rows)
+
+    recorders = before.get("flight_recorders")
+    if recorders:
+        out.append("\n### Flight recorders\n")
+        out.append("| node | events | top kinds | commits | timeouts |")
+        out.append("|---|---|---|---|---|")
+        for node, events in sorted(recorders.items()):
+            kinds: dict[str, int] = {}
+            for e in events:
+                k = e.get("kind", "?")
+                kinds[k] = kinds.get(k, 0) + 1
+            top = ", ".join(
+                f"{k}:{n}"
+                for k, n in sorted(kinds.items(), key=lambda kv: -kv[1])[:5]
+            )
+            out.append(
+                f"| {node} | {len(events)} | {top} | "
+                f"{kinds.get('commit', 0)} | {kinds.get('timeout', 0)} |"
+            )
+        triggers = before.get("watchdog_triggers") or []
+        dumps = before.get("watchdog_dumps") or []
+        if triggers:
+            out.append("\n**Anomaly watchdog triggers:**\n")
+            for t in triggers:
+                reason = t.get("reason", "?")
+                detail = {
+                    k: v for k, v in t.items() if k not in ("reason", "t")
+                }
+                out.append(f"- t={t.get('t')}: `{reason}` {detail}")
+            out.append(
+                f"\n({len(dumps)} anomaly-triggered recorder dump(s) "
+                "embedded in the report)"
+            )
 
     if not out:
         return "(no non-zero metrics)"

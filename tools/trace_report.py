@@ -1,0 +1,227 @@
+#!/usr/bin/env python3
+"""Stitch per-node flight-recorder dumps into a cross-node latency report.
+
+    # per-node dumps from `node run --trace-out node-N.trace.json`
+    python tools/trace_report.py node-*.trace.json
+
+    # a chaos report already embeds per-node recorder dumps
+    python tools/trace_report.py chaos.json --chrome timeline.json
+
+Inputs are either flight-recorder dump files (`utils/tracing.py
+write_json`: {"node", "anchor", "events"}) or a single chaos report
+carrying a `flight_recorders` section (`tools/chaos_run.py --report`).
+
+Outputs:
+  * a markdown **per-block commit-latency breakdown** — for every traced
+    block, the offset of each lifecycle stage
+    (proposal -> payload-fetch -> verify -> vote -> QC-assembly -> commit)
+    from the first propose stamp, as a min..max band across the nodes
+    that recorded the stage. This is the cross-node attribution the
+    per-process metric aggregates cannot answer: "where did block B
+    spend its time across the committee".
+  * with `--chrome PATH`, a Chrome/Perfetto `trace_event` JSON
+    (chrome://tracing or https://ui.perfetto.dev) — one process row per
+    node, duration slices for events carrying `dur`, instants otherwise.
+
+Cross-process clock alignment uses each dump's (mono, wall) anchor pair:
+aligned(t) = anchor.wall - (anchor.mono - t). Dumps from one process (a
+chaos report) share a clock, so alignment is the identity there.
+
+Dependency-free: stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+STAGES = ("propose", "payload", "verify", "vote", "qc", "commit")
+_BLOCK_TRACE = re.compile(r"^r(\d+)-([0-9a-f]{16})$")
+
+
+def load_inputs(paths: list[str]) -> list[dict]:
+    """Normalize every input into {"node", "offset", "events"} records.
+    `offset` maps the dump's mono clock onto the shared wall timeline."""
+    nodes = []
+    for path in paths:
+        with open(path) as f:
+            d = json.load(f)
+        if "scenarios" in d and "flight_recorders" not in d:
+            # A --scenario all sweep: scenarios reuse node labels and
+            # rounds, so stitching them together would corrupt the
+            # per-block timelines. Ask for one scenario explicitly.
+            names = [s.get("scenario", "?") for s in d["scenarios"]]
+            sys.exit(
+                f"{path}: multi-scenario sweep report ({', '.join(names)}); "
+                "re-run tools/chaos_run.py with a single --scenario to get "
+                "a stitchable report"
+            )
+        if "flight_recorders" in d:  # a chaos report: one shared clock
+            for label, events in sorted(d["flight_recorders"].items()):
+                nodes.append({"node": label, "offset": 0.0, "events": events})
+            continue
+        if "events" not in d:
+            sys.exit(f"{path}: neither a flight-recorder dump nor a chaos report")
+        anchor = d.get("anchor") or {}
+        offset = float(anchor.get("wall", 0.0)) - float(anchor.get("mono", 0.0))
+        label = d.get("node")
+        if label is None:
+            label = path
+        nodes.append({"node": str(label), "offset": offset, "events": d["events"]})
+    return nodes
+
+
+def stage_times(nodes: list[dict]) -> dict:
+    """block trace id -> {node -> {stage -> earliest aligned time}}."""
+    blocks: dict[str, dict[str, dict[str, float]]] = {}
+    for rec in nodes:
+        label, offset = rec["node"], rec["offset"]
+        for e in rec["events"]:
+            kind, trace = e.get("kind"), e.get("trace")
+            if kind not in STAGES or not trace or not _BLOCK_TRACE.match(trace):
+                continue
+            t = e["t"] + offset
+            per_node = blocks.setdefault(trace, {}).setdefault(label, {})
+            if kind not in per_node or t < per_node[kind]:
+                per_node[kind] = t
+    return blocks
+
+
+def _round_of(trace: str) -> int:
+    m = _BLOCK_TRACE.match(trace)
+    return int(m.group(1)) if m else -1
+
+
+def _band_ms(per_node: dict, stage: str, t0: float) -> str:
+    offs = [
+        ts[stage] - t0 for ts in per_node.values() if stage in ts
+    ]
+    if not offs:
+        return "-"
+    lo, hi = min(offs) * 1000.0, max(offs) * 1000.0
+    if abs(hi - lo) < 0.05:
+        return f"{hi:.1f}"
+    return f"{lo:.1f}..{hi:.1f}"
+
+
+def latency_table(blocks: dict, honest: set[str] | None = None) -> str:
+    """Markdown breakdown: one row per block, one column per stage with
+    the min..max offset (ms) from the earliest propose stamp across the
+    nodes that recorded the stage."""
+    rows = []
+    for trace in sorted(blocks, key=_round_of):
+        per_node = blocks[trace]
+        if honest is not None:
+            per_node = {n: ts for n, ts in per_node.items() if n in honest}
+        t0s = [ts["propose"] for ts in per_node.values() if "propose" in ts]
+        if not t0s:
+            continue
+        t0 = min(t0s)
+        nodes_full = sum(
+            1 for ts in per_node.values() if all(s in ts for s in STAGES)
+        )
+        cells = " | ".join(_band_ms(per_node, s, t0) for s in STAGES)
+        rows.append(
+            f"| {trace} | r{_round_of(trace)} | {cells} | "
+            f"{nodes_full}/{len(per_node)} |"
+        )
+    if not rows:
+        return "(no traced blocks)"
+    head = " | ".join(STAGES)
+    return (
+        "### Per-block commit latency (ms from first propose; min..max across nodes)\n\n"
+        f"| block | round | {head} | full-coverage nodes |\n"
+        "|---|---|" + "---|" * len(STAGES) + "---|\n"
+        + "\n".join(rows)
+    )
+
+
+def chrome_trace(nodes: list[dict]) -> dict:
+    """Chrome/Perfetto `trace_event` JSON: one process per node, duration
+    slices ("X") for events with dur, thread-scoped instants ("i")
+    otherwise. Timestamps are microseconds on the aligned timeline."""
+    events = []
+    base = None
+    for rec in nodes:
+        for e in rec["events"]:
+            t = e["t"] + rec["offset"]
+            base = t if base is None else min(base, t)
+    pids = {}
+    for rec in nodes:
+        pid = pids.setdefault(rec["node"], len(pids))
+        events.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": f"node-{rec['node']}"},
+            }
+        )
+        for e in rec["events"]:
+            ts = (e["t"] + rec["offset"] - (base or 0.0)) * 1e6
+            args = dict(e.get("data") or {})
+            if e.get("trace"):
+                args["trace"] = e["trace"]
+            entry = {
+                "name": e.get("kind", "?"),
+                "cat": "hotstuff",
+                "pid": pid,
+                "tid": 0,
+                "args": args,
+            }
+            dur = e.get("dur")
+            if dur is not None:
+                # dur spans END at the recorded stamp (stages record on
+                # completion): shift the slice start back by dur.
+                entry.update(
+                    ph="X", ts=max(0.0, ts - dur * 1e6), dur=dur * 1e6
+                )
+            else:
+                entry.update(ph="i", ts=ts, s="t")
+            events.append(entry)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def summarize(nodes: list[dict]) -> str:
+    lines = ["### Flight recorders\n", "| node | events | kinds |", "|---|---|---|"]
+    for rec in nodes:
+        kinds: dict[str, int] = {}
+        for e in rec["events"]:
+            kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+        top = ", ".join(
+            f"{k}:{n}" for k, n in sorted(kinds.items(), key=lambda kv: -kv[1])[:6]
+        )
+        lines.append(f"| {rec['node']} | {len(rec['events'])} | {top} |")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="trace_report", description=__doc__)
+    ap.add_argument(
+        "dumps", nargs="+",
+        help="flight-recorder dump files, or one chaos report JSON",
+    )
+    ap.add_argument(
+        "--chrome", default=None,
+        help="also write a Chrome/Perfetto trace_event JSON here",
+    )
+    args = ap.parse_args(argv)
+
+    nodes = load_inputs(args.dumps)
+    blocks = stage_times(nodes)
+    print(summarize(nodes))
+    print()
+    print(latency_table(blocks))
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(nodes), f, indent=1)
+            f.write("\n")
+        print(f"\nChrome trace written to {args.chrome}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
