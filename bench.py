@@ -330,6 +330,130 @@ def _record_junk_verification(kernel: str) -> None:
     )
 
 
+def _ingress_backend(kind: str):
+    """(label, error | None, CryptoBackend) for the ingress bench. `auto`
+    tries the device path and degrades to the dependency-free pure-python
+    verifier (carrying the relay error) instead of exiting nonzero — the
+    same rc-0 contract as the relay-down main bench. `pure` skips jax
+    entirely (the deterministic, always-available smoke path)."""
+    from hotstuff_tpu.crypto.pysigner import PurePythonBackend
+
+    if kind == "pure":
+        return "pure-python", None, PurePythonBackend()
+    try:
+        from hotstuff_tpu.ops import check_axon_relay, enable_persistent_cache
+
+        check_axon_relay()
+        import jax
+
+        enable_persistent_cache()
+        from hotstuff_tpu.crypto.backend import make_backend
+        from hotstuff_tpu.crypto.primitives import PublicKey, Signature
+        from hotstuff_tpu.crypto import pysigner
+
+        backend = make_backend("tpu")
+        # Probe the exact path ingress batches ride (small batches route
+        # to the host CPU side of the crossover): a host without the
+        # OpenSSL wheel would otherwise fail every verification mid-run
+        # and report committed=0 with no diagnosis.
+        pk, seed = pysigner.keypair_from_seed(bytes(32))
+        msg = b"ingress-bench-probe".ljust(32, b"\0")
+        mask = backend.verify_batch_mask(
+            [msg], [PublicKey(pk)], [Signature(pysigner.sign(seed, msg))]
+        )
+        if not mask[0]:
+            raise RuntimeError("backend probe rejected a valid signature")
+        return jax.default_backend(), None, backend
+    except Exception as e:
+        return "cpu-fallback", f"{type(e).__name__}: {e}", PurePythonBackend()
+
+
+def bench_ingress(args) -> None:
+    """The client-plane benchmark (`--ingress`): open-loop curve-shaped
+    signed traffic through a real IngressPipeline + BatchVerificationService
+    on THIS host, measuring offered vs committed (verified-and-forwarded)
+    tx/s, shed rate, and client latency percentiles — the INGRESS_rN.json
+    artifact. Real-time loop: the drain is backend-bound, so the committed
+    rate is the host's actual client-signature verification capacity."""
+    import asyncio
+    import random
+
+    payload: dict = {
+        "metric": "ingress_committed_tx_per_sec",
+        "value": 0.0,
+        "unit": "tx/s",
+    }
+    try:
+        label, backend_error, backend = _ingress_backend(args.ingress_backend)
+        from hotstuff_tpu.crypto.batch_service import BatchVerificationService
+        from hotstuff_tpu.ingress import (
+            ArrivalCurve,
+            IngressConfig,
+            IngressPipeline,
+            OpenLoopLoadGen,
+        )
+
+        duration = args.ingress_duration
+        curve = ArrivalCurve(
+            kind="flash",
+            rate=args.ingress_rate,
+            peak=args.ingress_rate * 5.0,
+            t_start=duration / 3.0,
+            t_end=2.0 * duration / 3.0,
+        )
+
+        async def drive():
+            service = BatchVerificationService(backend=backend)
+            sink: asyncio.Queue = asyncio.Queue(1_000_000)
+            committed = {"n": 0}
+
+            async def drain() -> None:
+                while True:
+                    await sink.get()
+                    committed["n"] += 1
+
+            drainer = asyncio.ensure_future(drain())
+            pipeline = IngressPipeline(
+                service, sink, IngressConfig(verify_batch=args.ingress_batch)
+            )
+            gen = OpenLoopLoadGen(
+                pipeline.submit,
+                curve=curve,
+                duration=duration,
+                clients=args.ingress_clients,
+                tx_bytes=64,
+                rng=random.Random(7),
+            )
+            summary = await gen.run()
+            drainer.cancel()
+            return summary, committed["n"]
+
+        summary, committed = asyncio.run(drive())
+        payload.update(
+            {
+                "value": round(committed / duration, 1),
+                "offered_tps": round(summary["offered"] / duration, 1),
+                "committed_tps": round(committed / duration, 1),
+                "offered": summary["offered"],
+                "accepted": summary["accepted"],
+                "shed": summary["shed"],
+                "retry_hints": summary["retry_hints"],
+                "shed_rate": round(summary["shed_rate"], 4),
+                "latency_ms": summary["latency_ms"],
+                "curve": summary["curve"],
+                "clients": args.ingress_clients,
+                "backend": label,
+            }
+        )
+        if backend_error is not None:
+            payload["error"] = backend_error
+    except Exception as e:
+        print(f"# ingress bench failed: {type(e).__name__}: {e}", file=sys.stderr)
+        payload["backend"] = "error"
+        payload["error"] = f"{type(e).__name__}: {e}"
+    _emit(payload, args.metrics_out, args.trace_out)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=16384)
@@ -370,6 +494,28 @@ def main() -> None:
         "driver JSON line",
     )
     ap.add_argument(
+        "--ingress",
+        action="store_true",
+        help="run the client-ingress benchmark instead of the kernel bench: "
+        "open-loop flash-crowd signed traffic through a real "
+        "IngressPipeline + BatchVerificationService, reporting offered vs "
+        "committed tx/s, shed rate, and client latency percentiles (the "
+        "INGRESS_rN.json artifact); degrades rc-0 with backend/error "
+        "fields like the relay-down path",
+    )
+    ap.add_argument(
+        "--ingress-backend",
+        choices=["auto", "pure"],
+        default="auto",
+        help="auto = device path, degrading to the pure-python verifier "
+        "when the relay/jax is unavailable; pure = dependency-free "
+        "pure-python verifier (no jax import at all)",
+    )
+    ap.add_argument("--ingress-rate", type=float, default=100.0)
+    ap.add_argument("--ingress-duration", type=float, default=10.0)
+    ap.add_argument("--ingress-clients", type=int, default=8)
+    ap.add_argument("--ingress-batch", type=int, default=64)
+    ap.add_argument(
         "--mesh",
         type=int,
         nargs="?",
@@ -386,6 +532,12 @@ def main() -> None:
         "correctness run",
     )
     args = ap.parse_args()
+
+    if args.ingress:
+        # The client-plane bench owns its backend selection (incl. the
+        # relay probe) and never needs the kernel workload below.
+        bench_ingress(args)
+        return
 
     from hotstuff_tpu.ops import check_axon_relay, enable_persistent_cache
 

@@ -76,6 +76,18 @@ def _parse_client(text: str) -> dict:
         )
     }
     out["misses"] = len(_search_all(r"rate too high", text))
+    # Ingress load-generator result lines (hotstuff_tpu/ingress/loadgen.py
+    # log_summary): open-loop offered/accepted/shed counts and
+    # client-observed latency percentiles. Absent on Front-only runs.
+    for pat, key, cast in [
+        (r"Ingress offered: (\d+) transactions", "ingress_offered", int),
+        (r"Ingress accepted: (\d+) transactions", "ingress_accepted", int),
+        (r"Ingress shed: (\d+) transactions", "ingress_shed", int),
+        (r"Ingress client latency p50: ([\d.]+) ms", "ingress_p50", float),
+        (r"Ingress client latency p99: ([\d.]+) ms", "ingress_p99", float),
+    ]:
+        m = re.search(pat, text)
+        out[key] = cast(m.group(1)) if m else None
     return out
 
 
@@ -176,6 +188,13 @@ class LogParser:
         self.steady_start = None
         self.sent_samples: dict[tuple[int, int], float] = {}
         self.misses = 0
+        self.ingress_offered = 0
+        self.ingress_accepted = 0
+        self.ingress_shed = 0
+        # Percentiles are not mergeable across clients: keep per-client
+        # values and report mean p50 / worst p99.
+        self.ingress_p50s: list[float] = []
+        self.ingress_p99s: list[float] = []
         for i, c in enumerate(_map_logs(_parse_client, clients)):
             self.size = self.size or c["size"]
             self.rate += c["rate"]
@@ -192,6 +211,13 @@ class LogParser:
             for sid, t in c["samples"].items():
                 self.sent_samples[(i, sid)] = t
             self.misses += c["misses"]
+            self.ingress_offered += c.get("ingress_offered") or 0
+            self.ingress_accepted += c.get("ingress_accepted") or 0
+            self.ingress_shed += c.get("ingress_shed") or 0
+            if c.get("ingress_p50") is not None:
+                self.ingress_p50s.append(c["ingress_p50"])
+            if c.get("ingress_p99") is not None:
+                self.ingress_p99s.append(c["ingress_p99"])
 
         # --- node logs ---
         self.proposals: dict[str, float] = {}  # block digest -> earliest created
@@ -371,6 +397,25 @@ class LogParser:
         e_tps, e_bps, _ = self.end_to_end_throughput()
         e_lat = self.end_to_end_latency()
         v_rate, v_total = self.verification_throughput()
+        ingress = ""
+        if self.ingress_offered:
+            shed_pct = 100.0 * self.ingress_shed / self.ingress_offered
+            # NOT statistics.mean: the histogram loop below shadows the
+            # name `mean` locally, so the import is unbound up here.
+            p50 = (
+                sum(self.ingress_p50s) / len(self.ingress_p50s)
+                if self.ingress_p50s
+                else 0.0
+            )
+            p99 = max(self.ingress_p99s) if self.ingress_p99s else 0.0
+            ingress = (
+                " + INGRESS:\n"
+                f" Offered: {self.ingress_offered:,} tx"
+                f" ({self.ingress_accepted:,} accepted,"
+                f" {self.ingress_shed:,} shed = {shed_pct:.1f} %)\n"
+                f" Client latency p50 (mean across clients): {p50:,.1f} ms\n"
+                f" Client latency p99 (worst client): {p99:,.1f} ms\n"
+            )
         mtr = ""
         if self.metrics["counters"] or self.metrics["histograms"]:
             lines = [
@@ -427,6 +472,7 @@ class LogParser:
                 if self.workload_shed
                 else ""
             )
+            + ingress
             + mtr
             + "-----------------------------------------\n"
         )

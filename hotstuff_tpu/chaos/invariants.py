@@ -118,6 +118,14 @@ class LivenessChecker:
     def on_commit(self, node: int, block, t: float) -> None:
         self._timeline.setdefault(node, []).append((t, block.round))
 
+    def commit_times(self) -> dict[int, list[float]]:
+        """Per-node commit instants (seconds on the run's clock), in
+        commit order — the report's plateau/throughput-window evidence."""
+        return {
+            node: [t for t, _r in entries]
+            for node, entries in self._timeline.items()
+        }
+
     def max_round(self, node: int, up_to: float | None = None) -> int:
         rounds = [
             r

@@ -104,6 +104,7 @@ class ChaosOrchestrator:
         byzantine: dict[int, object] | None = None,
         parameters: Parameters | None = None,
         store_dir: str | None = None,
+        ingress=None,  # ingress.loadgen.IngressLoad | None
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -137,6 +138,8 @@ class ChaosOrchestrator:
         self.safety = SafetyChecker(self.committee)
         self.liveness = LivenessChecker()
         self.honest = [i for i in range(n) if i not in self.byzantine]
+        self.ingress = ingress
+        self.ingress_drivers: list[tuple[int, object]] = []  # (node, loadgen)
         self.events: list[dict] = []
         self.nodes = [
             _NodeHandle(
@@ -191,6 +194,52 @@ class ChaosOrchestrator:
             )
             self.transport.set_policy(i, policy)
             node.policy = policy
+
+    def _boot_ingress(self) -> None:
+        """One in-process IngressPipeline + open-loop generator per target
+        node, wired to that node's BatchVerificationService — ingress
+        signatures ride the REAL verify path while consensus runs. The
+        generators draw from per-node seeded streams, so the traffic (and
+        therefore the whole run) replays bit-for-bit. Drivers live in the
+        run scope, not the node scopes: this models external clients, who
+        keep firing at a crashed node (submissions fail, not the run)."""
+        from ..ingress.loadgen import OpenLoopLoadGen
+        from ..ingress.pipeline import IngressPipeline
+
+        targets = (
+            list(self.ingress.targets)
+            if self.ingress.targets is not None
+            else list(self.honest)
+        )
+        for i in targets:
+            node = self.nodes[i]
+            trace_token = tracing.NODE_LABEL.set(i)
+            try:
+                # Sink stands in for the mempool tx queue (the chaos plane
+                # orders DeterministicMempool digests, so verified client
+                # bodies terminate here); bounded like the real one.
+                sink: asyncio.Queue = channel(10_000)
+                spawn(self._drain_ingress(sink), name=f"chaos-ingress-sink-{i}")
+                pipeline = IngressPipeline(
+                    node.service, sink, config=self.ingress.config()
+                )
+                gen = OpenLoopLoadGen(
+                    pipeline.submit,
+                    curve=self.ingress.curve,
+                    duration=self.ingress.duration,
+                    clients=self.ingress.clients,
+                    tx_bytes=self.ingress.tx_bytes,
+                    rng=self.rng.stream(f"ingress:{i}"),
+                    label=f"ingress-{i}",
+                )
+                spawn(gen.run(), name=f"chaos-ingress-{i}")
+            finally:
+                tracing.NODE_LABEL.reset(trace_token)
+            self.ingress_drivers.append((i, gen))
+
+    async def _drain_ingress(self, sink: asyncio.Queue) -> None:
+        while True:
+            await sink.get()
 
     async def _drain(self, i: int, commit_channel: asyncio.Queue) -> None:
         loop = asyncio.get_running_loop()
@@ -309,6 +358,8 @@ class ChaosOrchestrator:
             with run_scope:
                 for i in range(self.n):
                     self._boot(i)
+                if self.ingress is not None:
+                    self._boot_ingress()
                 if self.plan.crashes:
                     spawn(self._lifecycle(), name="chaos-lifecycle")
                 deadline = start + duration
@@ -353,6 +404,17 @@ class ChaosOrchestrator:
             "commits": {
                 str(i): self.safety.commits.get(i, [])
                 for i in range(self.n)
+            },
+            # Per-node commit instants (virtual seconds): the plateau
+            # evidence ingress-overload expectations compare windows over.
+            "commit_times": {
+                str(i): [round(t, 6) for t in ts]
+                for i, ts in self.liveness.commit_times().items()
+            },
+            # Per-target-node open-loop generator summaries (offered /
+            # accepted / shed / retry hints / client latency percentiles).
+            "ingress": {
+                str(i): gen.summary() for i, gen in self.ingress_drivers
             },
             "fault_trace": self.transport.trace,
             "fault_trace_overflow": self.transport.trace_overflow,

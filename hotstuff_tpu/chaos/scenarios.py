@@ -16,6 +16,8 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..consensus.config import Parameters
+from ..ingress.admission import IngressConfig, LaneSpec
+from ..ingress.loadgen import ArrivalCurve, IngressLoad
 from ..utils import metrics
 from . import vtime
 from .byzantine import Equivocator, SigForger, StaleReplayer, VoteWithholder
@@ -54,6 +56,10 @@ class Scenario:
     heal_t: float | None = None  # liveness must show progress past this
     expect: Callable[[dict, dict], list[str]] | None = None  # (report, metric deltas)
     slow: bool = False  # excluded from the tier-1 short sweep
+    # Open-loop client traffic (ingress/loadgen.IngressLoad factory): the
+    # orchestrator attaches one in-process ingress pipeline + generator
+    # per target node, riding each node's real verification service.
+    ingress: Callable[[], IngressLoad] | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -179,6 +185,22 @@ _register(
     )
 )
 
+def _expect_stale_replay(report: dict, deltas: dict) -> list[str]:
+    """Gate the replay-counter expectation on a replay actually having
+    been injected: the StaleReplayer needs to SEE at least two
+    blocks/TCs before it has stale material, and at some seeds the run
+    early-stops (min_commits reached) first — previously an EXPECT
+    failure with nothing wrong (the stale_qc_replay@seed2 flake). A full-
+    duration run with zero replays is still a failure: the adversary had
+    the whole window and injected nothing, so the scenario tested
+    nothing."""
+    replays = deltas.get("chaos.stale_replays", 0)
+    early_stop = report["virtual_seconds"] < report["duration_requested"]
+    if replays == 0 and early_stop:
+        return []
+    return _expect_counter(deltas, "chaos.stale_replays")
+
+
 _register(
     Scenario(
         name="stale_qc_replay",
@@ -188,10 +210,11 @@ _register(
         plan=lambda: FaultPlan(default_link=_LINK),
         byzantine={1: StaleReplayer},
         duration=60.0,
-        min_commits=3,
-        expect=lambda report, deltas: _expect_counter(
-            deltas, "chaos.stale_replays"
-        ),
+        # 5 (not 3): long enough that the replayer has stale material
+        # before the early-stop at almost any seed; the expectation above
+        # stays gated for the residue.
+        min_commits=5,
+        expect=_expect_stale_replay,
     )
 )
 
@@ -208,6 +231,99 @@ _register(
         expect=lambda report, deltas: _expect_counter(
             deltas, "chaos.withheld_votes"
         ),
+    )
+)
+
+# Flash-crowd ingress: deliberately small lanes + a paced drain (40 tx/s
+# capacity per node) so a 60 tx/s spike demonstrably overloads admission
+# under the virtual clock, where Python work costs zero virtual time and
+# an unpaced drain could never saturate.
+_FLASH_SPIKE = (5.0, 7.0)  # virtual-second spike window (see expectations)
+
+
+def _flash_ingress_config() -> IngressConfig:
+    return IngressConfig(
+        lanes=(
+            LaneSpec("priority", min_fee=1_000, capacity=8),
+            LaneSpec("standard", min_fee=1, capacity=16),
+            LaneSpec("bulk", min_fee=0, capacity=16),
+        ),
+        verify_batch=4,
+        verify_interval=0.1,
+    )
+
+
+def _commit_rate(report: dict, t0: float, t1: float) -> float:
+    """Aggregate honest commits/sec inside [t0, t1) from commit_times."""
+    n = sum(
+        1
+        for times in report.get("commit_times", {}).values()
+        for t in times
+        if t0 <= t < t1
+    )
+    return n / max(t1 - t0, 1e-9)
+
+
+def _expect_flash_crowd(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "ingress.shed")
+    problems += _expect_counter(deltas, "ingress.verified_sigs", minimum=20)
+    totals = {"offered": 0, "accepted": 0, "shed": 0, "retry_hints": 0}
+    for summary in report.get("ingress", {}).values():
+        for k in totals:
+            totals[k] += summary.get(k, 0)
+    if totals["shed"] and totals["retry_hints"] != totals["shed"]:
+        problems.append(
+            f"{totals['shed']} sheds but only {totals['retry_hints']} carried "
+            "a retry-after hint (backpressure contract: every shed names a "
+            "retry window)"
+        )
+    if not totals["accepted"]:
+        problems.append("no client transaction was accepted end-to-end")
+    # Commit throughput must hold its pre-overload plateau through the
+    # spike: overload lands on the ingress lanes (shed with backpressure),
+    # never on consensus. 0.75 here is the any-seed structural guard;
+    # tests/test_chaos.py pins the 10%-band acceptance figure at seed 11.
+    t0, t1 = _FLASH_SPIKE
+    pre = _commit_rate(report, 2.0, t0)
+    spike = _commit_rate(report, t0, t1)
+    if pre <= 0:
+        problems.append("no commits in the pre-overload window")
+    elif spike < 0.75 * pre:
+        problems.append(
+            f"committed throughput collapsed under the flash crowd: "
+            f"{spike:.2f}/s in the spike vs {pre:.2f}/s before"
+        )
+    return problems
+
+
+_register(
+    Scenario(
+        name="flash_crowd_ingress",
+        description="An open-loop flash crowd (4 -> 60 tx/s per node) hits "
+        "every node's authenticated ingress while consensus runs: admission "
+        "sheds with retry-after backpressure, ingress signatures ride each "
+        "node's real BatchVerificationService, and committed throughput "
+        "holds its pre-overload plateau.",
+        # 150 ms links: rounds stay realistic-paced, which bounds the
+        # PYTHON work 11 virtual seconds cost (every commit is ~a dozen
+        # pure-python signature ops — wall time, not virtual time).
+        plan=lambda: FaultPlan(default_link=LinkFaults(delay=0.15)),
+        duration=11.0,
+        min_commits=0,  # no early stop: the spike window must play out
+        ingress=lambda: IngressLoad(
+            curve=ArrivalCurve(
+                kind="flash",
+                rate=4,
+                peak=60,
+                t_start=_FLASH_SPIKE[0],
+                t_end=_FLASH_SPIKE[1],
+            ),
+            duration=10.0,
+            clients=3,
+            tx_bytes=32,
+            config=_flash_ingress_config,
+        ),
+        expect=_expect_flash_crowd,
     )
 )
 
@@ -231,7 +347,7 @@ _register(
 # The short sweep tier-1 runs (and the CLI's --scenario all default).
 SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
-_DELTA_PREFIXES = ("chaos.", "verifier.", "consensus.", "net.")
+_DELTA_PREFIXES = ("chaos.", "verifier.", "consensus.", "net.", "ingress.")
 
 
 def _counter_snapshot() -> dict:
@@ -256,6 +372,7 @@ def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
             plan=scenario.plan(),
             byzantine=dict(scenario.byzantine),
             parameters=scenario.parameters(),
+            ingress=scenario.ingress() if scenario.ingress else None,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,
@@ -277,6 +394,11 @@ def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
     deltas = {k: after.get(k, 0) - before.get(k, 0) for k in after}
     report["scenario"] = name
     report["description"] = scenario.description
+    # What the run was ASKED to last: expectations that gate on an early
+    # stop (min_commits reached) compare virtual_seconds against this.
+    report["duration_requested"] = (
+        duration if duration is not None else scenario.duration
+    )
     report["metrics"] = {k: v for k, v in sorted(deltas.items()) if v}
     if scenario.expect is not None:
         failures = scenario.expect(report, deltas)

@@ -1,0 +1,52 @@
+"""Client ingress plane: authenticated transaction submission with
+admission control, riding the shared batch-verification path.
+
+Layering (each file one responsibility):
+  * `messages.py`  — signed ClientTransaction + IngressResponse wire format
+  * `admission.py` — fee/priority lanes, bounded queues, shed + retry-after
+  * `pipeline.py`  — admission → BatchVerificationService → mempool seam
+  * `server.py`    — framed TCP RPC front-end (+ client)
+  * `loadgen.py`   — open-loop arrival curves, signed traffic, latency stats
+
+Entry points: `Mempool.run` boots an `IngressServer` when
+`MempoolParameters.ingress_enabled` is set (`node run --ingress`);
+`tools/loadgen.py` drives it; chaos scenarios attach in-process
+pipelines via `IngressLoad` (see chaos/orchestrator.py).
+"""
+
+from .admission import AdmissionController, IngressConfig, LaneSpec
+from .loadgen import ArrivalCurve, IngressLoad, OpenLoopLoadGen
+from .messages import (
+    ACCEPTED,
+    BAD_SIGNATURE,
+    MALFORMED,
+    REPLAY,
+    SHED,
+    ClientTransaction,
+    IngressResponse,
+    decode_ingress_message,
+    encode_ingress_message,
+)
+from .pipeline import IngressPipeline
+from .server import IngressClient, IngressServer
+
+__all__ = [
+    "ACCEPTED",
+    "BAD_SIGNATURE",
+    "MALFORMED",
+    "REPLAY",
+    "SHED",
+    "AdmissionController",
+    "ArrivalCurve",
+    "ClientTransaction",
+    "IngressClient",
+    "IngressConfig",
+    "IngressLoad",
+    "IngressPipeline",
+    "IngressResponse",
+    "IngressServer",
+    "LaneSpec",
+    "OpenLoopLoadGen",
+    "decode_ingress_message",
+    "encode_ingress_message",
+]

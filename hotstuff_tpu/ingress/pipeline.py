@@ -1,0 +1,187 @@
+"""IngressPipeline: admission → batched signature verification → mempool.
+
+The committee-independent verification lane the ROADMAP's traffic-plane
+item calls for: client transactions are admitted (ingress/admission.py),
+their ed25519 signatures verified in GROUPS through the node's shared
+`BatchVerificationService` — the same actor (and therefore the same
+TPU/CPU backend and crossover routing) that consensus certificates ride,
+but tagged `committee=False` (client keys are never in the validator
+table) and `dedup=False` — and only then forwarded into the
+PayloadMaker's transaction queue, the exact seam the raw Front feeds.
+
+Why `dedup=False`: the verified-signature LRU exists for consensus
+certificates, where one vote signature legitimately recurs across its
+QC's many appearances. Client transactions never legitimately repeat —
+a repeat is a replay, and the admission nonce filter rejects it before
+any crypto. Keeping client traffic out of the cache both preserves the
+cache for the certificate working set and closes a poisoning lever (a
+million distinct client txs would otherwise evict every consensus
+entry). It is also what makes the acceptance criterion measurable: under
+ingress load, `ingress.verified_sigs` advances while the dedup cache
+stays untouched by the client lane.
+
+Backpressure is end-to-end: if the mempool's transaction queue is full,
+`deliver.put` blocks the drain loop → lanes fill → admission sheds with
+retry-after. Nothing in the client path can grow without bound.
+
+Every stage stamps the PR 5 trace plane (`ingress.*` events, trace id
+derived from the transaction digest like the payload lane) and counts
+into the `ingress.*` metric namespace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..crypto.batch_service import BatchVerificationService
+from ..utils import metrics, tracing
+from ..utils.actors import spawn
+from . import messages
+from .admission import AdmissionController, IngressConfig
+from .messages import ClientTransaction, IngressResponse
+
+log = logging.getLogger("hotstuff.ingress")
+
+_M_RECEIVED = metrics.counter("ingress.received")
+_M_VERIFIED = metrics.counter("ingress.verified_sigs")
+_M_REJECTED = metrics.counter("ingress.rejected_sigs")
+_M_FORWARDED = metrics.counter("ingress.forwarded")
+_M_VERIFY_BATCH = metrics.histogram(
+    "ingress.verify_batch_size", metrics.SIZE_BUCKETS
+)
+_M_LATENCY = metrics.histogram("ingress.latency_s")
+
+LOG_EVERY = 10_000  # shed/reject log cadence
+
+
+class IngressPipeline:
+    """One per node. `deliver` is the PayloadMaker's tx queue (or any
+    bounded sink); `service` is the node's BatchVerificationService."""
+
+    def __init__(
+        self,
+        service: BatchVerificationService,
+        deliver: asyncio.Queue,
+        config: IngressConfig | None = None,
+    ) -> None:
+        self.service = service
+        self.deliver = deliver
+        self.admission = AdmissionController(config)
+        self._pending = asyncio.Event()  # set whenever a lane has work
+        self._task: asyncio.Task | None = None
+        self.stats = {"received": 0, "accepted": 0, "responded": 0}
+
+    def _ensure_task(self) -> None:
+        if self._task is None or self._task.done():
+            # actors.spawn: the drain loop joins the creating scope, so a
+            # chaos crash of the owning node tears it down too.
+            self._task = spawn(self._run(), name="ingress-drain")
+
+    # -- submission ----------------------------------------------------------
+
+    async def submit(self, tx: ClientTransaction) -> IngressResponse:
+        """Submit one client transaction; resolves to its response once
+        admission rejects it (immediately) or its verification batch
+        completes and the body is in the mempool queue."""
+        self._ensure_task()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        _M_RECEIVED.inc()
+        self.stats["received"] += 1
+        if tracing.enabled():
+            tracing.event("ingress.recv", tracing.trace_id(0, tx.digest().data))
+        future = loop.create_future()
+        lane, status, retry_ms = self.admission.admit(tx, (tx, t0, future))
+        if lane is None:
+            if tracing.enabled():
+                kind = (
+                    "ingress.shed" if status == messages.SHED else "ingress.reject"
+                )
+                tracing.event(
+                    kind,
+                    tracing.trace_id(0, tx.digest().data),
+                    status=messages.STATUS_NAMES.get(status, status),
+                    retry_after_ms=retry_ms,
+                )
+            shed = self.admission.shed
+            if status == messages.SHED and shed % LOG_EVERY == 1:
+                log.warning(
+                    "ingress overloaded: %s transactions shed with "
+                    "retry-after backpressure", shed,
+                )
+            _M_LATENCY.record(loop.time() - t0)
+            return IngressResponse(tx.nonce, status, retry_ms)
+        if tracing.enabled():
+            tracing.event(
+                "ingress.admit", tracing.trace_id(0, tx.digest().data), lane=lane
+            )
+        self._pending.set()
+        resp = await future
+        _M_LATENCY.record(loop.time() - t0)
+        return resp
+
+    # -- drain loop ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        cfg = self.admission.config
+        loop = asyncio.get_running_loop()
+        while True:
+            batch = self.admission.take(cfg.verify_batch)
+            if not batch:
+                self._pending.clear()
+                await self._pending.wait()
+                continue
+            msgs = [tx.digest().data for tx, _t0, _f in batch]
+            pairs = [(tx.client, tx.signature) for tx, _t0, _f in batch]
+            _M_VERIFY_BATCH.record(len(batch))
+            if tracing.enabled():
+                tracing.event(
+                    "ingress.verify",
+                    tracing.trace_id(0, batch[0][0].digest().data),
+                    n=len(batch),
+                )
+            try:
+                mask = await self.service.verify_group(
+                    msgs, pairs, urgent=False, committee=False, dedup=False
+                )
+            except Exception as e:
+                # A backend failure must not wedge clients: fail the whole
+                # batch as BAD_SIGNATURE (conservative — nothing unverified
+                # ever reaches the mempool) and keep draining.
+                log.warning("ingress verification dispatch failed: %r", e)
+                mask = [False] * len(batch)
+            accepted = 0
+            for (tx, _t0, future), ok in zip(batch, mask):
+                if ok:
+                    _M_VERIFIED.inc()
+                    accepted += 1
+                    # Bounded sink: blocking here is the backpressure path
+                    # (lanes fill behind us, admission sheds with
+                    # retry-after) — the one place ingress may wait.
+                    await self.deliver.put(tx.body)
+                    _M_FORWARDED.inc()
+                    if tracing.enabled():
+                        tracing.event(
+                            "ingress.forward", tracing.trace_id(0, tx.digest().data)
+                        )
+                    resp = IngressResponse(tx.nonce, messages.ACCEPTED)
+                else:
+                    _M_REJECTED.inc()
+                    self.admission.forget(tx)  # failed sigs release the nonce
+                    if tracing.enabled():
+                        tracing.event(
+                            "ingress.reject",
+                            tracing.trace_id(0, tx.digest().data),
+                            status="bad_signature",
+                        )
+                    resp = IngressResponse(tx.nonce, messages.BAD_SIGNATURE)
+                if not future.done():
+                    future.set_result(resp)
+                self.stats["responded"] += 1
+            self.stats["accepted"] += accepted
+            self.admission.note_drained(len(batch), loop.time())
+            if cfg.verify_interval:
+                # Deliberate drain pacing (see IngressConfig): capacity =
+                # verify_batch / verify_interval tx/s.
+                await asyncio.sleep(cfg.verify_interval)

@@ -95,6 +95,17 @@ class MempoolParameters:
     # triples are drawn cyclically from the pool).
     benchmark_mode: bool = False
     synthetic_pool_size: int = 10_000
+    # Bound on the Front's client-tx intake queue (drop-oldest past it,
+    # counted in mempool.front_dropped) — the raw benchmark port's share
+    # of the admission-control story (hotstuff_tpu/ingress has the
+    # authenticated one).
+    front_queue_capacity: int = 10_000
+    # Authenticated client ingress (hotstuff_tpu/ingress): when enabled,
+    # Mempool.run boots an IngressServer on front_port +
+    # ingress_port_offset, feeding verified client transactions into the
+    # same PayloadMaker queue the Front writes.
+    ingress_enabled: bool = False
+    ingress_port_offset: int = 1_000
     # Byzantine bound on PayloadRequest serving: at most this many payloads
     # are served per request frame (the prefix; the requester's retry loop
     # fetches the rest). Honest requests cover one block's digests —
@@ -119,6 +130,9 @@ class MempoolParameters:
             "benchmark_mode": self.benchmark_mode,
             "synthetic_pool_size": self.synthetic_pool_size,
             "max_request_digests": self.max_request_digests,
+            "front_queue_capacity": self.front_queue_capacity,
+            "ingress_enabled": self.ingress_enabled,
+            "ingress_port_offset": self.ingress_port_offset,
         }
 
     @staticmethod
@@ -132,6 +146,9 @@ class MempoolParameters:
             "benchmark_mode",
             "synthetic_pool_size",
             "max_request_digests",
+            "front_queue_capacity",
+            "ingress_enabled",
+            "ingress_port_offset",
         ):
             if k in obj:
                 setattr(p, k, obj[k])

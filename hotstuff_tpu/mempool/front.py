@@ -11,9 +11,12 @@ import asyncio
 import logging
 
 from ..network.net import Address, FrameReader
+from ..utils import metrics
 from ..utils.actors import spawn
 
 log = logging.getLogger("hotstuff.mempool")
+
+_M_FRONT_DROPPED = metrics.counter("mempool.front_dropped")
 
 
 class Front:
@@ -23,7 +26,15 @@ class Front:
     while it waits, so the node spends its capacity committing stale
     transactions nobody is waiting for anymore, and end-to-end latency
     grows without bound. Dropping the oldest keeps the queue fresh and
-    makes throughput flat (not collapsing) past saturation."""
+    makes throughput flat (not collapsing) past saturation.
+
+    The deliver queue's BOUND is the admission policy's other half:
+    Mempool.run sizes it from `MempoolParameters.front_queue_capacity`
+    (the previous implicit channel default left the bound undeclared),
+    and every eviction counts into `mempool.front_dropped` — the same
+    shed-visibility contract the authenticated ingress lanes
+    (hotstuff_tpu/ingress) carry, minus the per-client backpressure
+    response this anonymous port cannot deliver."""
 
     LOG_EVERY = 10_000  # dropped-tx log cadence
 
@@ -62,6 +73,7 @@ class Front:
                     pass
                 self._deliver.put_nowait(tx)
                 self.dropped += 1
+                _M_FRONT_DROPPED.inc()
                 if self.dropped % self.LOG_EVERY == 1:
                     log.warning(
                         "front overloaded: %s transactions dropped "

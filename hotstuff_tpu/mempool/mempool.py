@@ -40,7 +40,10 @@ class Mempool:
 
         core_channel = channel()
         network_tx = channel()
-        tx_client = channel()
+        # Explicitly bounded client-tx intake: the Front's drop-oldest
+        # admission and the ingress pipeline's backpressure both key off
+        # this queue filling up.
+        tx_client = channel(parameters.front_queue_capacity)
 
         front_addr = committee.front_address(name)
         mempool_addr = committee.mempool_address(name)
@@ -94,6 +97,25 @@ class Mempool:
             len(core.queue) >= parameters.queue_capacity
             or sender.egress_backlogged()
         )
+        if parameters.ingress_enabled:
+            # Authenticated client plane: signed transactions verify
+            # through the node's shared BatchVerificationService (a
+            # committee-independent lane) before joining the same
+            # PayloadMaker queue the raw Front feeds. CAVEAT: the queue
+            # is shared — with the anonymous Front ALSO receiving
+            # traffic, its drop-oldest overflow can evict ingress bodies
+            # (and its evictions keep freeing slots, so the pipeline's
+            # blocking put rarely exerts backpressure). Run ONE client
+            # plane for real traffic; splitting PayloadMaker intake into
+            # per-plane lanes is the continuous-batching scheduler's job
+            # (ROADMAP item 4).
+            from ..ingress.pipeline import IngressPipeline
+            from ..ingress.server import IngressServer
+
+            IngressServer(
+                ("0.0.0.0", front_addr[1] + parameters.ingress_port_offset),
+                IngressPipeline(core.verification_service, tx_client),
+            )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)
         return core

@@ -56,6 +56,10 @@ async def _run_node(args) -> None:
 
             warmup_backend(backend)
     node = Node(args.committee, args.keys, args.store, args.parameters)
+    if args.ingress:
+        # CLI override on top of the parameters file: boot the
+        # authenticated client ingress (front port + ingress_port_offset).
+        node.parameters.mempool.ingress_enabled = True
     # Committee registration at startup: validator keys become device-
     # resident verification precompute (decompression + window tables paid
     # once, not per batch), with the committee kernel compiled before the
@@ -156,6 +160,14 @@ def main(argv: list[str] | None = None) -> None:
         help="with --crypto tpu: shard verification over every attached "
         "device (ShardedEd25519Verifier); committee registration then "
         "replicates the validator tables onto every chip",
+    )
+    p_run.add_argument(
+        "--ingress",
+        action="store_true",
+        help="serve the authenticated client ingress (signed transactions, "
+        "admission control with fee/priority lanes, retry-after "
+        "backpressure) on front_port + mempool ingress_port_offset; "
+        "equivalent to ingress_enabled in the mempool parameters",
     )
     p_run.add_argument(
         "--no-warmup",

@@ -510,7 +510,21 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("mempool.gossip_dropped", "counter", None),
     ("mempool.synthetic_skipped", "counter", None),
     ("mempool.requests_clamped", "counter", None),
+    ("mempool.front_dropped", "counter", None),
     ("mempool.verify_batch_size", "histogram", SIZE_BUCKETS),
+    # ingress/ — authenticated client plane with admission control
+    ("ingress.received", "counter", None),
+    ("ingress.admitted", "counter", None),
+    ("ingress.shed", "counter", None),
+    ("ingress.replays", "counter", None),
+    ("ingress.malformed", "counter", None),
+    ("ingress.verified_sigs", "counter", None),
+    ("ingress.rejected_sigs", "counter", None),
+    ("ingress.forwarded", "counter", None),
+    ("ingress.lane_depth", "gauge", None),
+    ("ingress.retry_after_ms", "histogram", SIZE_BUCKETS),
+    ("ingress.verify_batch_size", "histogram", SIZE_BUCKETS),
+    ("ingress.latency_s", "histogram", None),
     # network/net.py
     ("net.bytes_sent", "counter", None),
     ("net.frames_sent", "counter", None),

@@ -110,6 +110,12 @@ EVENT_KINDS: frozenset[str] = frozenset(STAGES) | {
     "payload.gossip",
     "payload.stored",
     "payload.served",
+    "ingress.recv",
+    "ingress.admit",
+    "ingress.shed",
+    "ingress.verify",
+    "ingress.forward",
+    "ingress.reject",
     "verify.batch",
     "backpressure.on",
     "backpressure.off",

@@ -76,7 +76,7 @@ def test_virtual_time_loop_jumps_instead_of_sleeping():
 _FAST = [
     n
     for n in SHORT_SCENARIOS
-    if n not in ("partition_heal", "leader_crash")
+    if n not in ("partition_heal", "leader_crash", "flash_crowd_ingress")
 ]
 
 
@@ -152,6 +152,51 @@ def test_forged_signature_flood_rejected_everywhere():
     # certificate checks ran and found no false accepts
     assert report["metrics"]["chaos.invariant_checks"] > 0
     assert not any("FALSE ACCEPT" in v for v in report["safety_violations"])
+
+
+def test_stale_qc_replay_seed2_no_flake():
+    """Regression for the known pre-existing flake: at seed 2 the scenario
+    early-stopped before the StaleReplayer had stale material, and the
+    replay-counter expectation failed vacuously. The expectation is now
+    gated on a replay actually having been injected (and the commit floor
+    raised so the run usually lasts long enough to inject one)."""
+    report = run_scenario("stale_qc_replay", seed=2)
+    assert report["ok"], report
+    assert report.get("expectation_failures", []) == []
+
+
+def test_flash_crowd_ingress_sheds_and_holds_plateau():
+    """The ingress acceptance row: an open-loop flash crowd against every
+    node's authenticated ingress — admission sheds with explicit
+    retry-after backpressure, ingress signatures ride each node's real
+    BatchVerificationService, safety/liveness invariants stay clean, and
+    committed throughput holds within 10% of the pre-overload plateau
+    (deterministic at this seed)."""
+    from hotstuff_tpu.chaos.scenarios import _FLASH_SPIKE, _commit_rate
+
+    report = run_scenario("flash_crowd_ingress", seed=11)
+    assert report["ok"], report
+    assert report["safety_violations"] == []
+    assert report["liveness_violations"] == []
+    assert report.get("expectation_failures", []) == []
+    # every target node shed under the spike, and every shed carried a
+    # retry-after hint (the explicit client backpressure contract)
+    summaries = report["ingress"].values()
+    assert summaries
+    for s in summaries:
+        assert s["offered"] > s["accepted"] > 0
+        assert s["shed"] > 0 and s["retry_hints"] == s["shed"]
+        assert s["latency_ms"]["p99"] >= s["latency_ms"]["p50"] > 0
+    # signatures demonstrably rode the verification service
+    assert report["metrics"]["ingress.verified_sigs"] > 0
+    assert report["metrics"]["ingress.shed"] > 0
+    # the acceptance figure: spike-window commit rate within 10% of the
+    # pre-overload plateau (virtual time makes this exact per seed)
+    t0, t1 = _FLASH_SPIKE
+    pre = _commit_rate(report, 2.0, t0)
+    spike = _commit_rate(report, t0, t1)
+    assert pre > 0
+    assert spike >= 0.9 * pre, (pre, spike)
 
 
 @pytest.mark.slow

@@ -222,6 +222,42 @@ def test_log_parser_reports_workload_shed():
     assert "Workload shed at saturation: >= 200,390 sigs" in p.result()
 
 
+def test_log_parser_scrapes_ingress_lines():
+    """The ingress load generator's result lines (loadgen.log_summary)
+    surface as an INGRESS section: offered/accepted/shed totals summed
+    across clients, mean p50, worst p99; absent on Front-only runs."""
+    from benchmark.logs import LogParser
+
+    assert "+ INGRESS" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    ingress_lines = (
+        "[2026-07-30T10:00:20.000Z INFO hotstuff.loadgen] Ingress offered: "
+        "840 transactions\n"
+        "[2026-07-30T10:00:20.001Z INFO hotstuff.loadgen] Ingress accepted: "
+        "510 transactions\n"
+        "[2026-07-30T10:00:20.002Z INFO hotstuff.loadgen] Ingress shed: "
+        "330 transactions\n"
+        "[2026-07-30T10:00:20.003Z INFO hotstuff.loadgen] Ingress client "
+        "latency p50: 76.0 ms\n"
+        "[2026-07-30T10:00:20.004Z INFO hotstuff.loadgen] Ingress client "
+        "latency p99: 7626.0 ms\n"
+    )
+    quiet_client = CLIENT_LOG  # a client with no ingress traffic
+    loud_client = CLIENT_LOG + ingress_lines
+    louder = CLIENT_LOG + ingress_lines.replace("76.0", "100.0").replace(
+        "7626.0", "9000.0"
+    )
+    p = LogParser([quiet_client, loud_client, louder], [NODE_LOG])
+    assert p.ingress_offered == 1_680
+    assert p.ingress_accepted == 1_020
+    assert p.ingress_shed == 660
+    assert p.ingress_p50s == [76.0, 100.0]
+    out = p.result()
+    assert "+ INGRESS:" in out
+    assert "1,680 tx (1,020 accepted, 660 shed = 39.3 %)" in out
+    assert "p50 (mean across clients): 88.0 ms" in out
+    assert "p99 (worst client): 9,000.0 ms" in out
+
+
 def test_log_parser_surfaces_watchdog_firings():
     """Anomaly-watchdog WARNING lines (utils/tracing.py) surface as a
     summary warning with reasons and dump count; absent when quiet."""
@@ -398,6 +434,90 @@ def test_chaos_run_cli_rejects_unknown_scenario(tmp_path):
     )
     assert proc.returncode == 3
     assert "unknown scenario" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# tools/loadgen.py: the open-loop ingress load generator CLI
+
+
+def test_loadgen_cli_selftest_smoke(tmp_path):
+    """rc 0 and a well-formed JSON summary from the in-process selftest
+    (virtual-time loop, pure-python signatures — no node, no jax, no
+    OpenSSL wheel). The flash spike exceeds the paced capacity, so the
+    summary must show shedding with retry hints."""
+    import json
+    import subprocess
+    import sys
+
+    out_path = tmp_path / "loadgen.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "tools", "loadgen.py"),
+            "--selftest",
+            "--curve", "flash",
+            "--rate", "15",
+            "--peak", "90",
+            "--duration", "6",
+            "--capacity", "30",
+            "--clients", "4",
+            "--seed", "3",
+            "--json-out", str(out_path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary == json.loads(out_path.read_text())
+    for key in (
+        "curve", "offered", "accepted", "shed", "retry_hints",
+        "shed_rate", "latency_ms", "mode",
+    ):
+        assert key in summary, key
+    assert summary["mode"] == "selftest"
+    assert summary["offered"] > summary["accepted"] > 0
+    assert summary["shed"] > 0 and summary["retry_hints"] == summary["shed"]
+    assert summary["latency_ms"]["p99"] >= summary["latency_ms"]["p50"]
+
+
+def test_bench_ingress_mode_emits_artifact(tmp_path):
+    """`bench.py --ingress --ingress-backend pure` exits rc 0 with the
+    INGRESS_rN.json-shaped line: arrival curve, offered vs committed
+    tx/s, latency percentiles, backend field."""
+    import json
+    import subprocess
+    import sys
+
+    metrics_path = tmp_path / "ingress-metrics.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(os.path.dirname(__file__), "..", "bench.py"),
+            "--ingress",
+            "--ingress-backend", "pure",
+            "--ingress-rate", "20",
+            "--ingress-duration", "3",
+            "--ingress-clients", "3",
+            "--metrics-out", str(metrics_path),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    body = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert body["metric"] == "ingress_committed_tx_per_sec"
+    assert body["backend"] == "pure-python"
+    for key in ("curve", "offered_tps", "committed_tps", "shed", "latency_ms"):
+        assert key in body, key
+    assert body["committed_tps"] > 0
+    # the metrics artifact carries the ingress namespace with real counts
+    dump = json.loads(metrics_path.read_text())
+    assert dump["counters"]["ingress.received"] == body["offered"]
+    assert dump["counters"]["ingress.forwarded"] > 0
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,202 @@
+#!/usr/bin/env python3
+"""Open-loop signed-transaction load generator CLI.
+
+    # against a live node's authenticated ingress port (node run --ingress):
+    python tools/loadgen.py --target 127.0.0.1:8200 --curve flash \
+        --rate 100 --peak 1000 --spike-start 10 --spike-end 15 --duration 30
+
+    # self-contained demo / smoke mode: boots an in-process ingress
+    # pipeline (pure-python backend, paced drain) on the chaos virtual-time
+    # loop — no node, no jax, no OpenSSL wheel, deterministic per --seed:
+    python tools/loadgen.py --selftest --curve flash --duration 20
+
+Traffic is OPEN loop (hotstuff_tpu/ingress/loadgen.py): arrivals follow
+the curve regardless of responses, which is what makes admission control
+observable — a closed-loop client slows itself down and can never
+saturate anything. Every transaction is ed25519-signed by one of
+--clients identities via the dependency-free pysigner.
+
+Prints ONE JSON summary line (offered/accepted/shed counts, shed rate,
+client latency percentiles, the curve) to stdout; --json-out also writes
+it to a file. The scrapeable `Ingress ...` log lines land on stderr with
+-v (benchmark/logs.py collects them from harness client logs).
+
+Exit codes: 0 = ran (sheds are a measurement, not a failure);
+2 = transport errors, unresolved submissions, or bad flags (argparse);
+3 = malformed --target.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hotstuff_tpu.ingress import (  # noqa: E402
+    ArrivalCurve,
+    IngressClient,
+    IngressConfig,
+    IngressPipeline,
+    LaneSpec,
+    OpenLoopLoadGen,
+)
+
+
+def _curve_from_args(args) -> ArrivalCurve:
+    return ArrivalCurve(
+        kind=args.curve,
+        rate=args.rate,
+        peak=args.peak if args.peak else args.rate * 5.0,
+        t_start=args.spike_start,
+        t_end=args.spike_end,
+        period=args.period,
+    )
+
+
+def _selftest_config(capacity: float) -> IngressConfig:
+    """Small lanes + a paced drain (`capacity` tx/s) so overload — and
+    therefore shedding and retry-after hints — is demonstrable without a
+    real backend behind the pipeline."""
+    batch = 8
+    return IngressConfig(
+        lanes=(
+            LaneSpec("priority", min_fee=1_000, capacity=32),
+            LaneSpec("standard", min_fee=1, capacity=64),
+            LaneSpec("bulk", min_fee=0, capacity=64),
+        ),
+        verify_batch=batch,
+        verify_interval=batch / max(capacity, 1.0),
+    )
+
+
+async def _drive(submit, args, rng) -> dict:
+    gen = OpenLoopLoadGen(
+        submit,
+        curve=_curve_from_args(args),
+        duration=args.duration,
+        clients=args.clients,
+        tx_bytes=args.tx_bytes,
+        rng=rng,
+    )
+    await gen.run()
+    return gen.log_summary()
+
+
+def _run_selftest(args) -> dict:
+    import random
+
+    from hotstuff_tpu.chaos import vtime
+    from hotstuff_tpu.crypto.batch_service import BatchVerificationService
+    from hotstuff_tpu.crypto.pysigner import PurePythonBackend
+
+    async def body() -> dict:
+        service = BatchVerificationService(
+            backend=PurePythonBackend(), inline=True
+        )
+        sink: asyncio.Queue = asyncio.Queue(100_000)
+
+        async def drain() -> None:
+            while True:
+                await sink.get()
+
+        drainer = asyncio.ensure_future(drain())
+        pipeline = IngressPipeline(
+            service, sink, _selftest_config(args.capacity)
+        )
+        try:
+            summary = await _drive(pipeline.submit, args, random.Random(args.seed))
+        finally:
+            drainer.cancel()
+        summary["mode"] = "selftest"
+        return summary
+
+    return vtime.run(body(), timeout=args.duration * 20 + 600, wall_timeout=600)
+
+
+def _run_tcp(args) -> dict:
+    import random
+
+    host, _, port_s = args.target.rpartition(":")
+    if not host or not port_s.isdigit():
+        print(f"malformed --target {args.target!r}: need host:port", file=sys.stderr)
+        raise SystemExit(3)  # argparse owns flag errors (rc 2)
+    port = port_s
+
+    async def body() -> dict:
+        client = IngressClient()
+        await client.connect((host, int(port)))
+        try:
+            summary = await _drive(client.submit, args, random.Random(args.seed))
+        finally:
+            client.close()
+        summary["mode"] = "tcp"
+        summary["target"] = args.target
+        return summary
+
+    return asyncio.run(body())
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="loadgen", description=__doc__)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument(
+        "--target", default=None, help="ingress address host:port of a live node"
+    )
+    mode.add_argument(
+        "--selftest",
+        action="store_true",
+        help="drive an in-process ingress pipeline on the virtual-time loop",
+    )
+    ap.add_argument(
+        "--curve",
+        default="sustained",
+        choices=["sustained", "diurnal", "flash"],
+    )
+    ap.add_argument("--rate", type=float, default=100.0, help="base tx/s")
+    ap.add_argument(
+        "--peak", type=float, default=0.0, help="spike/ramp peak tx/s (default 5x rate)"
+    )
+    ap.add_argument("--spike-start", type=float, default=0.0)
+    ap.add_argument("--spike-end", type=float, default=0.0)
+    ap.add_argument("--period", type=float, default=60.0, help="diurnal period (s)")
+    ap.add_argument("--duration", type=float, default=10.0)
+    ap.add_argument("--clients", type=int, default=8, help="signing identities")
+    ap.add_argument("--tx-bytes", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument(
+        "--capacity",
+        type=float,
+        default=80.0,
+        help="selftest drain capacity (tx/s) the curve runs against",
+    )
+    ap.add_argument("--json-out", default=None, help="also write the summary here")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.curve == "flash" and args.spike_end <= args.spike_start:
+        # A flash curve without a window is just `sustained`; default the
+        # spike to the middle third of the run.
+        args.spike_start = args.duration / 3.0
+        args.spike_end = 2.0 * args.duration / 3.0
+
+    logging.basicConfig(
+        level=logging.INFO if args.verbose else logging.WARNING,
+        format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
+    )
+
+    summary = _run_selftest(args) if args.selftest else _run_tcp(args)
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(line + "\n")
+    return 2 if summary.get("errors") or summary.get("unresolved") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
