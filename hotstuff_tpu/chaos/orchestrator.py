@@ -26,6 +26,8 @@ import os
 import tempfile
 import time
 
+from dataclasses import dataclass
+
 from ..consensus import Consensus
 from ..consensus.config import Committee, Parameters
 from ..consensus.mempool_driver import (
@@ -37,7 +39,8 @@ from ..consensus.mempool_driver import (
 from ..crypto import pysigner
 from ..crypto.backend import set_backend
 from ..crypto.batch_service import BatchVerificationService
-from ..crypto.primitives import Digest, PublicKey
+from ..crypto.primitives import Digest, PublicKey, Signature
+from ..crypto.scheduler import SchedulerConfig
 from ..network import net
 from ..store import Store
 from ..utils import metrics, tracing
@@ -52,6 +55,32 @@ _M_CRASHES = metrics.counter("chaos.crashes")
 _M_RESTARTS = metrics.counter("chaos.restarts")
 
 BASE_PORT = 25_000  # virtual — the transport keys on port, nothing binds
+
+
+@dataclass(slots=True)
+class BulkFlood:
+    """Declarative bulk-verification flood for chaos scenarios: the
+    orchestrator drives `rate` groups/s of `group_size` signatures per
+    target node straight into that node's BatchVerificationService on the
+    scheduler's given `source` lane, while consensus runs its critical
+    groups through the same scheduler.
+
+    Groups draw cyclically from a small per-node pool of pre-signed
+    pysigner triples with dedup=True: after the first pass the
+    VerifiedSigCache absorbs the backend cost (bounded WALL time — the
+    pure verifier costs ~20 ms/sig), while the scheduler's
+    `pace_s_per_sig` occupancy model still charges full VIRTUAL device
+    time per dispatched signature — which is what makes bulk queueing,
+    and therefore critical-lane preemption, observable under the virtual
+    clock."""
+
+    rate: float  # groups per virtual second per target node
+    group_size: int = 16
+    duration: float = 8.0
+    t_start: float = 0.0
+    pool: int = 8  # distinct pre-signed triples per node
+    source: str = "mempool"
+    targets: tuple[int, ...] | None = None  # node indices; None = all honest
 
 
 class DeterministicMempool:
@@ -105,6 +134,8 @@ class ChaosOrchestrator:
         parameters: Parameters | None = None,
         store_dir: str | None = None,
         ingress=None,  # ingress.loadgen.IngressLoad | None
+        flood: BulkFlood | None = None,
+        scheduler_config: SchedulerConfig | None = None,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -140,6 +171,11 @@ class ChaosOrchestrator:
         self.honest = [i for i in range(n) if i not in self.byzantine]
         self.ingress = ingress
         self.ingress_drivers: list[tuple[int, object]] = []  # (node, loadgen)
+        self.flood = flood
+        self.flood_stats: dict[int, dict] = {}  # node -> driver counters
+        # Per-node scheduler knobs (e.g. the virtual device-occupancy pace
+        # the bulk_flood_priority scenario needs); None = defaults.
+        self.scheduler_config = scheduler_config
         self.events: list[dict] = []
         self.nodes = [
             _NodeHandle(
@@ -169,7 +205,9 @@ class ChaosOrchestrator:
                     self.rng.stream(f"mempool:{i}")
                 )
                 mempool.start()
-                node.service = BatchVerificationService(inline=True)
+                node.service = BatchVerificationService(
+                    inline=True, scheduler_config=self.scheduler_config
+                )
                 commit_channel = channel()
                 Consensus.run(
                     node.pk,
@@ -240,6 +278,68 @@ class ChaosOrchestrator:
     async def _drain_ingress(self, sink: asyncio.Queue) -> None:
         while True:
             await sink.get()
+
+    def _boot_flood(self) -> None:
+        """One open-loop bulk-verification driver per target node (see
+        BulkFlood). Drivers live in the run scope like the ingress
+        generators — external load keeps firing at a crashed node
+        (submissions are skipped, not the run)."""
+        targets = (
+            list(self.flood.targets)
+            if self.flood.targets is not None
+            else list(self.honest)
+        )
+        for i in targets:
+            stats = {"submitted": 0, "completed": 0, "verified": 0, "errors": 0}
+            self.flood_stats[i] = stats
+            spawn(
+                self._flood_node(i, self.rng.stream(f"flood:{i}"), stats),
+                name=f"chaos-flood-{i}",
+            )
+
+    async def _flood_node(self, i: int, rng, stats: dict) -> None:
+        flood = self.flood
+        # Pre-signed pool (wall-time bound: pool * ~20 ms pysigner signs);
+        # groups cycle it with dedup=True so only the first pass pays the
+        # backend while every dispatch pays virtual device occupancy.
+        pool = []
+        for _ in range(flood.pool):
+            pk, seed = pysigner.keypair_from_seed(rng.randbytes(32))
+            msg = rng.randbytes(32)
+            pool.append((msg, PublicKey(pk), Signature(pysigner.sign(seed, msg))))
+        loop = asyncio.get_running_loop()
+        start = loop.time() + flood.t_start
+        if flood.t_start > 0:
+            await asyncio.sleep(flood.t_start)
+        end = start + flood.duration
+        interval = 1.0 / flood.rate
+        cursor = 0
+        while loop.time() < end:
+            node = self.nodes[i]
+            if node.running and node.service is not None:
+                msgs, pairs = [], []
+                for _ in range(flood.group_size):
+                    m, pk, sig = pool[cursor % len(pool)]
+                    cursor += 1
+                    msgs.append(m)
+                    pairs.append((pk, sig))
+                stats["submitted"] += 1
+                spawn(
+                    self._flood_submit(node.service, msgs, pairs, stats),
+                    name=f"chaos-flood-submit-{i}",
+                )
+            await asyncio.sleep(interval)
+
+    async def _flood_submit(self, service, msgs, pairs, stats: dict) -> None:
+        try:
+            mask = await service.verify_group(
+                msgs, pairs, source=self.flood.source, dedup=True
+            )
+        except Exception:
+            stats["errors"] += 1
+        else:
+            stats["completed"] += 1
+            stats["verified"] += sum(bool(ok) for ok in mask)
 
     async def _drain(self, i: int, commit_channel: asyncio.Queue) -> None:
         loop = asyncio.get_running_loop()
@@ -360,6 +460,8 @@ class ChaosOrchestrator:
                     self._boot(i)
                 if self.ingress is not None:
                     self._boot_ingress()
+                if self.flood is not None:
+                    self._boot_flood()
                 if self.plan.crashes:
                     spawn(self._lifecycle(), name="chaos-lifecycle")
                 deadline = start + duration
@@ -415,6 +517,20 @@ class ChaosOrchestrator:
             # accepted / shed / retry hints / client latency percentiles).
             "ingress": {
                 str(i): gen.summary() for i, gen in self.ingress_drivers
+            },
+            # Per-node bulk-flood driver counters (BulkFlood scenarios).
+            "flood": {
+                str(i): dict(stats) for i, stats in self.flood_stats.items()
+            },
+            # Per-node device-scheduler snapshots: lane depths/dispatch
+            # counts and the per-lane queue-delay percentiles the
+            # bulk_flood_priority expectations assert on (service-local
+            # LaneStats — global histograms would bleed across the
+            # scenarios one tier-1 process runs back to back).
+            "scheduler": {
+                str(i): node.service.scheduler.summary()
+                for i, node in enumerate(self.nodes)
+                if node.service is not None and node.service.scheduler is not None
             },
             "fault_trace": self.transport.trace,
             "fault_trace_overflow": self.transport.trace_overflow,

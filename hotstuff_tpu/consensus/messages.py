@@ -117,7 +117,8 @@ class QC:
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
         mask = await service.verify_group(
-            msgs, pairs, urgent=True, committee=True, trace=trace
+            msgs, pairs, urgent=True, committee=True, trace=trace,
+            source="consensus"
         )
         ensure(all(mask), InvalidSignatureError("QC batch verification failed"))
 
@@ -174,7 +175,8 @@ class TC:
         self.check_quorum(committee)
         msgs, pairs = self.signed_items()
         mask = await service.verify_group(
-            msgs, pairs, urgent=True, committee=True, trace=trace
+            msgs, pairs, urgent=True, committee=True, trace=trace,
+            source="consensus"
         )
         ensure(all(mask), InvalidSignatureError("TC batch verification failed"))
 
@@ -302,7 +304,8 @@ class Block:
             msgs += m
             pairs += p
         mask = await service.verify_group(
-            msgs, pairs, urgent=True, committee=True, trace=trace
+            msgs, pairs, urgent=True, committee=True, trace=trace,
+            source="consensus"
         )
         ensure(mask[0], InvalidSignatureError(f"bad block signature B{self.round}"))
         ensure(
@@ -435,7 +438,8 @@ class Timeout:
             msgs += m
             pairs += p
         mask = await service.verify_group(
-            msgs, pairs, urgent=True, committee=True, trace=trace
+            msgs, pairs, urgent=True, committee=True, trace=trace,
+            source="consensus"
         )
         ensure(mask[0], InvalidSignatureError(f"bad timeout signature T{self.round}"))
         ensure(

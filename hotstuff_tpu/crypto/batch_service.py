@@ -1,25 +1,29 @@
-"""BatchVerificationService: deadline-flushed signature-verification actor.
+"""BatchVerificationService: the verification façade over the device
+scheduler.
 
 The north-star constraint (BASELINE.json): TPU batch verification must not
 regress consensus latency — QC formation blocks round advancement, so
-per-vote verification cannot wait for a large batch to fill. This actor
+per-vote verification cannot wait for a large batch to fill. This service
 generalises the reference's SignatureService request/oneshot seam
 (crypto/src/lib.rs:226-252) to verification: callers submit GROUPS of
 (message, key, signature) triples (a QC's votes, one synthetic payload
-batch, or a single vote) and await a per-item validity mask. The actor
-concatenates pending groups and flushes to the active CryptoBackend when
+batch, or a single vote), DECLARE their source class (`source=`:
+consensus-critical / sync / ingress / mempool-bulk — crypto/scheduler.py),
+and await a per-item validity mask.
 
-  * the pending total reaches `max_batch` (size flush, TPU-efficient),
-  * the oldest group is `max_delay` seconds old (deadline flush, keeps
-    p99 latency bounded at low rates — SURVEY.md §7 "hard parts" item 1), or
-  * an URGENT group is pending (consensus-critical: QC/TC/vote checks gate
-    round advancement, so they flush after an opportunistic drain instead
-    of waiting out the deadline).
+Batching policy lives in the continuous-batching DeviceScheduler
+(crypto/scheduler.py): typed priority lanes, a preemptive critical lane,
+alignment-grid bucket sizing, continuous refill. This class remains the
+DISPATCH EXECUTOR — dedup cache, committee tagging, the backend call,
+future resolution — and the thin source-registration façade callers see.
+The pre-scheduler single-queue flush heuristics survive as
+`use_scheduler=False` (`_run_legacy`), kept as the measured baseline for
+`bench.py --scheduler-ab`.
 
 The backend call runs in a worker thread so the TPU dispatch never blocks
 the event loop (the mempool/consensus cores keep processing while a batch
 is in flight — the same pipelining the reference gets from tokio). Groups
-are enqueued whole (one queue item, one future per group), so per-item
+are enqueued whole (one lane entry, one future per group), so per-item
 asyncio overhead is O(1) per group, not O(n) — at 100k+ sigs/s the Python
 queue would otherwise dominate the TPU kernel.
 """
@@ -37,6 +41,13 @@ from typing import Sequence
 from ..utils import metrics, tracing
 from .backend import CryptoBackend, get_backend
 from .primitives import PublicKey, Signature
+from .scheduler import (
+    DeviceScheduler,
+    LaneStats,
+    SchedulerConfig,
+    note_queue_delay,
+    resolve_source,
+)
 
 log = logging.getLogger("hotstuff.crypto")
 
@@ -117,6 +128,13 @@ class _Group:
     # flight recorder can attribute this batch's verification cost to the
     # block whose QC/vote/proposal it checks.
     trace: str | None = None
+    # Source class (crypto/scheduler.py) + queueing timestamps: t_submit is
+    # stamped at admission, t_dequeue when a bucket (or legacy flush) takes
+    # the group — their difference is the per-lane queueing delay the
+    # scheduler metrics and verify.batch trace events attribute.
+    source: str = "mempool"
+    t_submit: float = 0.0
+    t_dequeue: float = 0.0
     future: asyncio.Future = field(default_factory=lambda: asyncio.get_running_loop().create_future())
 
     def __len__(self) -> int:
@@ -132,6 +150,8 @@ class BatchVerificationService:
         max_concurrent_dispatches: int = 4,
         dedup_cache_size: int = 65536,
         inline: bool = False,
+        use_scheduler: bool = True,
+        scheduler_config: SchedulerConfig | None = None,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
@@ -149,6 +169,24 @@ class BatchVerificationService:
         )
         self._queue: asyncio.Queue[_Group] = asyncio.Queue()
         self._task: asyncio.Task | None = None
+        # Per-lane queueing-delay reservoir, fed by BOTH flush paths (the
+        # scheduler's dequeue and the legacy loop) — the bench A/B and the
+        # chaos scheduler expectations read per-service p50/p99 from here.
+        self.lane_stats = LaneStats()
+        # The continuous-batching device scheduler (crypto/scheduler.py) is
+        # the default flush policy; use_scheduler=False keeps the legacy
+        # single-queue heuristics as the measured A/B baseline.
+        self.scheduler: DeviceScheduler | None = (
+            DeviceScheduler(
+                self._spawn_dispatch,
+                max_batch=max_batch,
+                alignment_fn=self._bucket_alignment,
+                config=scheduler_config,
+                lane_stats=self.lane_stats,
+            )
+            if use_scheduler
+            else None
+        )
         # Flushes dispatch CONCURRENTLY (bounded): an urgent 3-signature QC
         # check must not wait out a multi-thousand-signature workload batch
         # already in flight on the device (backends route small batches to
@@ -170,11 +208,21 @@ class BatchVerificationService:
             # node tears down its verification flush loop too.
             from ..utils.actors import spawn
 
-            self._task = spawn(self._run(), name="batch-verification-service")
+            loop = (
+                self.scheduler.run()
+                if self.scheduler is not None
+                else self._run_legacy()
+            )
+            self._task = spawn(loop, name="batch-verification-service")
 
     @property
     def backend(self) -> CryptoBackend:
         return self._backend or get_backend()
+
+    def _bucket_alignment(self) -> int:
+        """The device bucket grid the scheduler sizes bulk buckets against
+        (TpuBackend.bucket_alignment; 0 for gridless backends)."""
+        return getattr(self.backend, "bucket_alignment", 0)
 
     # -- submission API ------------------------------------------------------
 
@@ -186,11 +234,15 @@ class BatchVerificationService:
         committee: bool = False,
         dedup: bool = True,
         trace: str | None = None,
+        source: str | None = None,
     ) -> list[bool]:
         """Submit a correlated group (e.g. one QC's votes or one synthetic
         payload batch); resolves to the per-item validity mask once the
-        group's flush completes. `committee=True` tags the group as signed
-        by registered validator keys, routing it to the backend's
+        group's flush completes. `source` declares the group's scheduler
+        class ("consensus" | "sync" | "ingress" | "mempool" —
+        crypto/scheduler.py); when omitted, the legacy `urgent` bit maps to
+        consensus-critical vs mempool bulk. `committee=True` tags the group
+        as signed by registered validator keys, routing it to the backend's
         committee-resident kernel when available; `dedup=False` bypasses
         the verified-signature cache (synthetic benchmark load, where
         repeats are intentional and must pay full verification); `trace`
@@ -199,16 +251,22 @@ class BatchVerificationService:
         if not messages:
             return []
         self._ensure_task()
+        cls = resolve_source(source, urgent)
         group = _Group(
             list(messages),
             [pk for pk, _ in pairs],
             [sig for _, sig in pairs],
-            urgent,
+            cls.preemptive,
             committee,
             dedup,
             trace,
+            cls.name,
+            asyncio.get_running_loop().time(),
         )
-        await self._queue.put(group)
+        if self.scheduler is not None:
+            self.scheduler.submit(group)
+        else:
+            await self._queue.put(group)
         return await group.future
 
     async def verify(
@@ -219,10 +277,12 @@ class BatchVerificationService:
         urgent: bool = True,
         committee: bool = False,
         trace: str | None = None,
+        source: str | None = None,
     ) -> bool:
         """Await a single verification (batched under the hood)."""
         mask = await self.verify_group(
-            [message], [(key, signature)], urgent, committee, trace=trace
+            [message], [(key, signature)], urgent, committee, trace=trace,
+            source=source,
         )
         return mask[0]
 
@@ -235,9 +295,15 @@ class BatchVerificationService:
         if self.dedup is not None:
             self.dedup.add(message, key, signature)
 
-    # -- flush loop ----------------------------------------------------------
+    # -- flush loops ---------------------------------------------------------
+    #
+    # Production rides DeviceScheduler.run() (crypto/scheduler.py). The
+    # legacy single-queue heuristics below are retained as the measured
+    # baseline for `bench.py --scheduler-ab` (use_scheduler=False): size /
+    # deadline / urgent flushing with no lanes, no alignment sizing, no
+    # continuous refill.
 
-    async def _run(self) -> None:
+    async def _run_legacy(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
             first = await self._queue.get()
@@ -265,6 +331,14 @@ class BatchVerificationService:
                 total += len(g)
                 urgent |= g.urgent
 
+            # The legacy path stamps dequeue time at flush decision, so the
+            # per-lane queue-delay attribution is directly comparable with
+            # the scheduler's (same submit -> dequeue definition).
+            now = loop.time()
+            for g in groups:
+                g.t_dequeue = now
+                note_queue_delay(self.lane_stats, g.source, max(0.0, now - g.t_submit))
+
             # Urgent groups dispatch in their OWN flush, immediately: a
             # 3-signature QC check must neither ride a multi-thousand-
             # signature workload batch down the device path nor wait for a
@@ -283,12 +357,15 @@ class BatchVerificationService:
             else:
                 self._spawn_dispatch(groups, total, False)
 
-    def _spawn_dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
+    def _spawn_dispatch(
+        self, groups: list[_Group], total: int, urgent: bool
+    ) -> asyncio.Task:
         from ..utils.actors import spawn
 
         task = spawn(self._dispatch(groups, total, urgent), name="verify-dispatch")
         self._dispatches.add(task)
         task.add_done_callback(self._dispatches.discard)
+        return task
 
     async def _dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
         if not urgent:
@@ -344,13 +421,18 @@ class BatchVerificationService:
                 dur = time.perf_counter() - t0
                 if tracing.enabled():
                     # One verify.batch event per traced group in the flush
-                    # (batch tags), plus a watchdog sample of the flush's
-                    # per-signature cost for regression detection.
+                    # (batch tags + the group's scheduler lane and queueing
+                    # delay, the per-class attribution trace_report.py's
+                    # verify-lane table aggregates), plus a watchdog sample
+                    # of the flush's per-signature cost.
                     for g in groups:
                         if g.trace is not None:
                             tracing.event(
                                 "verify.batch", g.trace, dur,
-                                n=len(g), flush=len(miss),
+                                n=len(g), flush=len(miss), lane=g.source,
+                                queue_s=round(
+                                    max(0.0, g.t_dequeue - g.t_submit), 6
+                                ),
                             )
                     tracing.WATCHDOG.note_verify(dur, len(miss))
                 for i, ok in zip(miss, sub):

@@ -1,0 +1,536 @@
+"""Continuous-batching device scheduler with preemptive priority lanes.
+
+Every device-bound verification — QC/TC-critical consensus checks, mempool
+bulk, sync/payload re-verification, and client ingress — used to funnel
+through one set of per-service flush heuristics (batch_service._run_legacy):
+a single queue, a single deadline, one `urgent` bit. That design has no
+vocabulary for "ingress is latency-sensitive but not commit-critical" and
+no way to size buckets against the device's alignment grid, so a bulk or
+ingress flood and a quorum-sized QC check were fate-shared into the same
+coalesced flushes.
+
+This module is the LLM-serving continuous-batching pattern applied to the
+verify plane (ROADMAP item 4): typed **sources**, each with a priority
+class and latency SLO, feed one admission → bucket → dispatch loop:
+
+  * **Preemptive critical lane.** Consensus-critical groups never wait out
+    a lower-class flush timer: any pending critical work is drained and
+    dispatched FIRST on every loop pass, bypassing the bulk dispatch bound
+    entirely (small quorum batches ride the backend's CPU fast path, so
+    unbounded critical dispatches are bounded in practice by the consensus
+    message rate). A critical arrival also CLOSES the forming bulk bucket
+    early — the formed groups ship right behind it instead of restarting
+    their deadline, so preemption never re-delays bulk.
+  * **Alignment-grid bucket sizing.** Bulk buckets are sized dynamically
+    against the backend's bucket alignment (`TpuBackend.bucket_alignment`:
+    `lane × ndev` on a mesh — parallel/mesh.py's `mesh_alignment` — or the
+    single-chip `min_bucket`): once a full grid row of work is pending the
+    bucket closes, so the device pays its padded lanes with real work in
+    them. Backends with no grid (CPU, pure-python) fall back to
+    deadline/size flushing alone.
+  * **Continuous refill.** Bucket formation runs concurrently with the
+    bounded in-flight dispatches: as one bucket dispatches, the next forms
+    from whatever sources have work, so the device never idles between
+    heterogeneous batches. Buckets are lane-ordered (sync before ingress
+    before mempool) but may mix classes — per-group queueing delay is
+    attributed to each group's own lane regardless.
+
+The scheduler owns admission, per-lane queueing, and bucket formation;
+the owning BatchVerificationService stays the dispatch executor (dedup
+cache, committee tagging, backend call, future resolution) — its public
+`verify_group` API is a thin source-registration façade over `submit()`.
+
+Observability: per-lane queueing-delay histograms (`scheduler.queue_<lane>_s`)
+plus bucket/flush counters in the `scheduler.*` namespace, a per-service
+`LaneStats` reservoir (the bench A/B and chaos expectations read p50/p99
+from it), and `lane=`/`queue_s=` fields on every traced group's
+`verify.batch` event so `tools/trace_report.py` attributes queueing delay
+per class.
+
+Deterministic by construction: no wall-clock reads (event-loop time only),
+no threads of its own — under the chaos VirtualTimeLoop with `inline=True`
+dispatch, a scheduled run replays bit-for-bit. `pace_s_per_sig` models
+finite device occupancy in VIRTUAL time (a bucket of n signatures holds
+the bulk pipeline for n×pace seconds), which is what makes queueing — and
+therefore preemption — observable under a clock where Python work costs
+zero virtual seconds.
+
+Dependency-free: stdlib + utils.metrics/tracing only (no jax, no crypto).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable
+
+from ..utils import metrics
+
+log = logging.getLogger("hotstuff.crypto")
+
+__all__ = [
+    "SourceClass",
+    "SOURCE_CLASSES",
+    "CONSENSUS",
+    "SYNC",
+    "INGRESS",
+    "MEMPOOL",
+    "SchedulerConfig",
+    "LaneStats",
+    "DeviceScheduler",
+    "resolve_source",
+    "note_queue_delay",
+    "drain_order",
+]
+
+
+@dataclass(frozen=True, slots=True)
+class SourceClass:
+    """One typed verification source: a priority class + latency SLO.
+
+    `priority` orders lane draining (lower drains first); `slo_s` is the
+    published queueing-delay target the per-lane histograms are judged
+    against (advisory — reported, never enforced); `max_delay_s` bounds
+    how long a forming bucket may wait for more work once this class has
+    a group pending; `preemptive` marks the critical lane (immediate
+    dispatch, bypasses the bulk bound, closes forming buckets early)."""
+
+    name: str
+    priority: int
+    slo_s: float
+    max_delay_s: float
+    preemptive: bool = False
+
+
+# The four registered sources (ISSUE 7 / ROADMAP item 4). QC/TC/vote/
+# proposal checks gate round advancement — preemptive, no flush timer.
+# Sync/payload re-verification un-stalls consensus availability — tight
+# deadline, drained first among the batched lanes. Ingress is client-
+# latency-sensitive bulk; mempool is pure measurement load and starves
+# first under pressure (the lane contract, mirroring ingress admission).
+CONSENSUS = SourceClass("consensus", 0, slo_s=0.002, max_delay_s=0.0, preemptive=True)
+SYNC = SourceClass("sync", 1, slo_s=0.020, max_delay_s=0.001)
+INGRESS = SourceClass("ingress", 2, slo_s=0.100, max_delay_s=0.002)
+MEMPOOL = SourceClass("mempool", 3, slo_s=0.500, max_delay_s=0.004)
+
+SOURCE_CLASSES: dict[str, SourceClass] = {
+    c.name: c for c in (CONSENSUS, SYNC, INGRESS, MEMPOOL)
+}
+
+
+def resolve_source(source: str | None, urgent: bool) -> SourceClass:
+    """Map a verify_group call to its SourceClass. Explicit `source` wins;
+    the legacy `urgent` bit keeps un-migrated callers working (urgent ==
+    consensus-critical, everything else is mempool bulk)."""
+    if source is not None:
+        try:
+            return SOURCE_CLASSES[source]
+        except KeyError:
+            raise ValueError(
+                f"unknown verification source {source!r}; registered: "
+                f"{sorted(SOURCE_CLASSES)}"
+            ) from None
+    return CONSENSUS if urgent else MEMPOOL
+
+
+_M_SUBMITTED = metrics.counter("scheduler.submitted")
+_M_DISPATCHED = metrics.counter("scheduler.dispatched_groups")
+_M_BUCKETS = metrics.counter("scheduler.buckets")
+_M_CRITICAL = metrics.counter("scheduler.critical_dispatches")
+_M_SIZE_FLUSHES = metrics.counter("scheduler.size_flushes")
+_M_GRID_FLUSHES = metrics.counter("scheduler.grid_flushes")
+_M_DEADLINE_FLUSHES = metrics.counter("scheduler.deadline_flushes")
+_M_PREEMPT_CLOSES = metrics.counter("scheduler.preempt_closes")
+_M_DEPTH = metrics.gauge("scheduler.depth")
+_M_BUCKET_SIZE = metrics.histogram("scheduler.bucket_size", metrics.SIZE_BUCKETS)
+# Per-lane queueing delay (submit -> dequeue-into-a-bucket). The f-string
+# keeps lane names and histogram rows in lockstep; tools/lint_metrics.py
+# separately asserts every registered class has its row in the canonical
+# namespace (the starvation lint's schema half).
+_QUEUE_HIST = {
+    name: metrics.histogram(f"scheduler.queue_{name}_s")
+    for name in SOURCE_CLASSES
+}
+
+
+def note_queue_delay(lane_stats: "LaneStats", source: str, queue_s: float) -> None:
+    """Record one group's queueing delay into the lane's global histogram
+    and the service-local reservoir. Shared by the scheduler's dequeue and
+    the legacy flush loop, so before/after attribution is comparable."""
+    hist = _QUEUE_HIST.get(source)
+    if hist is not None:
+        hist.record(queue_s)
+    lane_stats.note(source, queue_s)
+
+
+class LaneStats:
+    """Per-service per-lane queueing-delay reservoir.
+
+    The global `scheduler.queue_<lane>_s` histograms aggregate across every
+    service in the process; chaos scenarios and the bench A/B need
+    PER-SERVICE percentiles (one node's critical lane, one A/B leg), so
+    each BatchVerificationService keeps its own bounded sample lists here —
+    both the scheduler and the legacy flush loop feed it, which is exactly
+    what makes the before/after queueing attribution comparable."""
+
+    CAP = 65_536  # samples kept per lane; enough for any bench leg
+
+    def __init__(self) -> None:
+        self._samples: dict[str, list[float]] = {
+            name: [] for name in SOURCE_CLASSES
+        }
+
+    def note(self, lane: str, queue_s: float) -> None:
+        samples = self._samples.setdefault(lane, [])
+        if len(samples) < self.CAP:
+            samples.append(queue_s)
+
+    def summary(self) -> dict[str, dict]:
+        """{lane: {count, p50_ms, p99_ms, max_ms}} for lanes that saw work."""
+        out = {}
+        for lane, samples in self._samples.items():
+            if not samples:
+                continue
+            ordered = sorted(samples)
+            out[lane] = {
+                "count": len(ordered),
+                "p50_ms": round(metrics.percentile(ordered, 0.50) * 1e3, 3),
+                "p99_ms": round(metrics.percentile(ordered, 0.99) * 1e3, 3),
+                "max_ms": round(ordered[-1] * 1e3, 3),
+            }
+        return out
+
+
+@dataclass(slots=True)
+class SchedulerConfig:
+    """Knobs beyond what the owning service already carries.
+
+    `bulk_concurrency` bounds in-flight NON-critical buckets (2 = double
+    buffering: stage the next bucket while one is on the device; more
+    slots only add host-thread contention against the critical lane).
+    `pace_s_per_sig` is the virtual device-occupancy model for chaos runs
+    (0 = backend-bound, production)."""
+
+    bulk_concurrency: int = 2
+    pace_s_per_sig: float = 0.0
+
+
+class _Lane:
+    __slots__ = ("cls", "queue", "enqueued", "dispatched")
+
+    def __init__(self, cls: SourceClass) -> None:
+        self.cls = cls
+        self.queue: deque = deque()
+        self.enqueued = 0
+        self.dispatched = 0
+
+
+class DeviceScheduler:
+    """The admission → bucket → dispatch loop.
+
+    `dispatch(groups, total, critical)` is the owning service's executor
+    hook (BatchVerificationService._spawn_dispatch): it must return the
+    spawned task, whose completion frees a bulk slot. Groups only need
+    `.source`, `.t_submit`, `.t_dequeue` and `__len__` — the scheduler
+    never looks at messages or futures, which is what keeps the lint's
+    drain-order simulation (and unit tests) dependency-free."""
+
+    def __init__(
+        self,
+        dispatch: Callable[[list, int, bool], "asyncio.Task"],
+        *,
+        max_batch: int = 8192,
+        alignment_fn: Callable[[], int] | None = None,
+        config: SchedulerConfig | None = None,
+        lane_stats: LaneStats | None = None,
+        classes: tuple[SourceClass, ...] | None = None,
+    ) -> None:
+        self._dispatch = dispatch
+        self.max_batch = max_batch
+        self._alignment_fn = alignment_fn or (lambda: 0)
+        self.config = config or SchedulerConfig()
+        self.lane_stats = lane_stats or LaneStats()
+        classes = classes or tuple(SOURCE_CLASSES.values())
+        ordered = sorted(classes, key=lambda c: c.priority)
+        self._critical = [c.name for c in ordered if c.preemptive]
+        self._batched = [c.name for c in ordered if not c.preemptive]
+        self.lanes: dict[str, _Lane] = {c.name: _Lane(c) for c in ordered}
+        self._inflight_bulk = 0
+        self._wake: asyncio.Event | None = None  # bound lazily to the loop
+        self.stats = {
+            "submitted": 0,
+            "buckets": 0,
+            "critical_dispatches": 0,
+            "preempt_closes": 0,
+        }
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, group) -> None:
+        """Admit one group into its lane (synchronous — lanes are unbounded
+        like the legacy queue; backpressure stays with the callers, e.g.
+        ingress admission and the mempool's verify semaphores)."""
+        self.lanes[group.source].queue.append(group)
+        self.lanes[group.source].enqueued += 1
+        self.stats["submitted"] += 1
+        _M_SUBMITTED.inc()
+        _M_DEPTH.set(self.depth())
+        if self._wake is not None:
+            self._wake.set()
+
+    def depth(self) -> int:
+        return sum(len(lane.queue) for lane in self.lanes.values())
+
+    # -- bucket formation (pure: unit-testable, reused by the lint) ----------
+
+    def _take(self, group, now: float, bucket: list) -> None:
+        group.t_dequeue = now
+        lane = self.lanes[group.source]
+        lane.dispatched += 1
+        note_queue_delay(self.lane_stats, group.source, max(0.0, now - group.t_submit))
+        bucket.append(group)
+
+    def drain_critical(self, now: float) -> list:
+        """Pop EVERY pending preemptive-lane group (they coalesce into one
+        hot bucket — simultaneous QC + vote checks still flush together)."""
+        out: list = []
+        for name in self._critical:
+            queue = self.lanes[name].queue
+            while queue:
+                self._take(queue.popleft(), now, out)
+        return out
+
+    def form_bucket(self, now: float, force: bool = False) -> tuple[list, str] | None:
+        """Close and return one batched-lane bucket, or None if the loop
+        should keep waiting. Close conditions, in order:
+
+          * `force`   — a critical dispatch just preempted the forming
+                        bucket: ship what has accumulated (preempt close).
+          * size      — pending work fills max_batch.
+          * grid      — a full device alignment row is pending (zero pad
+                        waste; alignment 0 disables this trigger).
+          * deadline  — the oldest pending group aged past its class's
+                        max_delay_s (bounds p99 at low rates, and bounds
+                        starvation of the lowest lane: its deadline forces
+                        a flush that drains lanes in priority order).
+
+        Groups are indivisible (one future per group), so the last group
+        taken may overshoot the grid target; it never overshoots max_batch
+        unless it is single-handedly larger than max_batch."""
+        pending = sum(
+            len(g) for name in self._batched for g in self.lanes[name].queue
+        )
+        if pending == 0:
+            return None
+        reason = None
+        target = self.max_batch
+        if force:
+            reason = "preempt"
+        elif pending >= self.max_batch:
+            reason = "size"
+        else:
+            align = self._alignment_fn()
+            if align > 0 and pending >= align:
+                # Close at the largest full grid multiple and leave the
+                # remainder forming: the dispatched bucket pads zero lanes,
+                # and the residue's own deadline still bounds its wait.
+                reason = "grid"
+                target = (pending // align) * align
+            else:
+                deadline = self._next_deadline()
+                if deadline is not None and now >= deadline:
+                    reason = "deadline"
+        if reason is None:
+            return None
+        bucket: list = []
+        total = 0
+        for name in self._batched:
+            queue = self.lanes[name].queue
+            while queue and (total < target or not bucket):
+                g = queue.popleft()
+                self._take(g, now, bucket)
+                total += len(g)
+            if total >= target:
+                break
+        return bucket, reason
+
+    def _next_deadline(self) -> float | None:
+        """Earliest (t_submit + class max_delay) across pending batched
+        groups — FIFO lanes mean only each lane's head matters."""
+        deadline = None
+        for name in self._batched:
+            lane = self.lanes[name]
+            if lane.queue:
+                d = lane.queue[0].t_submit + lane.cls.max_delay_s
+                if deadline is None or d < deadline:
+                    deadline = d
+        return deadline
+
+    # -- dispatch loop -------------------------------------------------------
+
+    def note_bulk_done(self, _task=None) -> None:
+        """Done-callback for non-critical dispatch tasks: frees a bulk slot
+        and wakes the loop so the next bucket can ship (continuous refill)."""
+        self._inflight_bulk -= 1
+        if self._wake is not None:
+            self._wake.set()
+
+    def _ship_critical(self, now: float) -> bool:
+        hot = self.drain_critical(now)
+        if not hot:
+            return False
+        self.stats["critical_dispatches"] += 1
+        _M_CRITICAL.inc()
+        _M_DISPATCHED.inc(len(hot))
+        _M_DEPTH.set(self.depth())
+        # Bypasses the bulk bound AND the pace model: critical work is
+        # never delayed by a lower-class flush timer or a busy bulk
+        # pipeline (small quorum batches ride the backend's CPU fast path).
+        self._dispatch(hot, sum(len(g) for g in hot), True)
+        return True
+
+    async def _pace_busy(self, dur: float, loop) -> None:
+        """Hold the bulk pipeline busy for `dur` seconds of loop time
+        (virtual under chaos) without ever delaying the critical lane:
+        wake-ups inside the window ship any pending critical work, then
+        the remaining occupancy elapses."""
+        end = loop.time() + dur
+        while True:
+            remaining = end - loop.time()
+            if remaining <= 0:
+                return
+            self._wake.clear()
+            try:
+                await asyncio.wait_for(self._wake.wait(), remaining)
+            except asyncio.TimeoutError:
+                return
+            self._ship_critical(loop.time())
+
+    async def run(self) -> None:
+        """The single admission → bucket → dispatch loop. Spawned by the
+        owning service (actors.spawn, so a chaos crash-restart of a node
+        tears it down with the rest of the node's task tree)."""
+        loop = asyncio.get_running_loop()
+        if self._wake is None:
+            self._wake = asyncio.Event()
+        pace = self.config.pace_s_per_sig
+        while True:
+            now = loop.time()
+            # 1. Critical lane first, always; remember whether it preempted
+            #    a forming (non-empty, not-yet-closed) batched backlog.
+            preempted = self._ship_critical(now)
+            # 2. One batched bucket, if a slot is free and a close condition
+            #    holds (a preempt close ships the formed groups immediately
+            #    so the critical jump never re-delays them).
+            if self._inflight_bulk < self.config.bulk_concurrency:
+                formed = self.form_bucket(now, force=preempted)
+                if formed is not None:
+                    bucket, reason = formed
+                    total = sum(len(g) for g in bucket)
+                    self.stats["buckets"] += 1
+                    _M_BUCKETS.inc()
+                    _M_DISPATCHED.inc(len(bucket))
+                    _M_BUCKET_SIZE.record(total)
+                    _M_DEPTH.set(self.depth())
+                    if reason == "preempt":
+                        self.stats["preempt_closes"] += 1
+                        _M_PREEMPT_CLOSES.inc()
+                    elif reason == "size":
+                        _M_SIZE_FLUSHES.inc()
+                    elif reason == "grid":
+                        _M_GRID_FLUSHES.inc()
+                    else:
+                        _M_DEADLINE_FLUSHES.inc()
+                    self._inflight_bulk += 1
+                    task = self._dispatch(bucket, total, False)
+                    task.add_done_callback(self.note_bulk_done)
+                    if pace > 0.0:
+                        # Virtual device-occupancy model (chaos): the bulk
+                        # pipeline is busy for total*pace seconds — but the
+                        # sleep is PREEMPTIBLE: a critical arrival ships
+                        # mid-occupancy, then the remainder elapses.
+                        await self._pace_busy(total * pace, loop)
+                    continue
+            # 3. Nothing dispatchable: wait for new work, a freed bulk
+            #    slot, or the earliest pending deadline. form_bucket only
+            #    returns None while every pending deadline is strictly in
+            #    the future, so the timeout is always > 0 (no zero-delay
+            #    re-arm livelock under the virtual clock — utils/actors.py
+            #    Timer RESOLUTION_S rationale).
+            self._wake.clear()
+            if self.depth() > 0 and self._ship_critical(loop.time()):
+                continue  # raced a critical submit against the clear
+            deadline = self._next_deadline()
+            waitable = self._inflight_bulk < self.config.bulk_concurrency
+            timeout = None
+            if deadline is not None and waitable:
+                timeout = max(0.0, deadline - loop.time())
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+
+    def summary(self) -> dict:
+        """Structured per-lane snapshot (chaos reports embed one per node)."""
+        return {
+            "lanes": {
+                name: {
+                    "priority": lane.cls.priority,
+                    "slo_ms": round(lane.cls.slo_s * 1e3, 3),
+                    "enqueued": lane.enqueued,
+                    "dispatched": lane.dispatched,
+                    "depth": len(lane.queue),
+                }
+                for name, lane in self.lanes.items()
+            },
+            "queue_delay": self.lane_stats.summary(),
+            **self.stats,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Starvation lint support (tools/lint_metrics.py)
+
+
+class _StubGroup:
+    """Minimal group shape for the drain-order simulation: the scheduler's
+    formation logic only reads source/t_submit/len()."""
+
+    __slots__ = ("source", "t_submit", "t_dequeue", "n")
+
+    def __init__(self, source: str, t_submit: float, n: int = 1) -> None:
+        self.source = source
+        self.t_submit = t_submit
+        self.t_dequeue = 0.0
+        self.n = n
+
+    def __len__(self) -> int:
+        return self.n
+
+
+def drain_order(classes: tuple[SourceClass, ...] | None = None) -> list[str]:
+    """Simulate the loop's selection over one group per registered class
+    with NO further arrivals, advancing a synthetic clock past each pending
+    deadline, and return the lane names in the order their groups were
+    dequeued. A registered class missing from the result can be enqueued
+    but never selected — the starvation condition tools/lint_metrics.py
+    fails the build on (rc 1)."""
+    sched = DeviceScheduler(lambda groups, total, critical: None)
+    classes = classes or tuple(SOURCE_CLASSES.values())
+    now = 0.0
+    for cls in classes:
+        sched.submit(_StubGroup(cls.name, now))
+    order: list[str] = []
+    for _ in range(4 * len(classes) + 4):  # bounded: no arrivals, must drain
+        for g in sched.drain_critical(now):
+            order.append(g.source)
+        formed = sched.form_bucket(now)
+        if formed is not None:
+            order.extend(g.source for g in formed[0])
+        if sched.depth() == 0:
+            break
+        deadline = sched._next_deadline()
+        now = (deadline if deadline is not None else now) + 1e-6
+    return order

@@ -125,6 +125,17 @@ class TpuBackend(CryptoBackend):
         self._lock = threading.Lock()
         self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
+    @property
+    def bucket_alignment(self) -> int:
+        """The device bucket grid: `lane * ndev` on a mesh
+        (parallel/mesh.py `mesh_alignment`), the narrowest bucket width on
+        a single chip. The continuous-batching scheduler
+        (crypto/scheduler.py) sizes bulk buckets against this so a closed
+        bucket pads zero lanes; gridless backends (CPU, pure-python)
+        simply lack the attribute."""
+        v = self._verifier
+        return getattr(v, "mesh_alignment", 0) or getattr(v, "min_bucket", 0)
+
     # -- committee registration ---------------------------------------------
 
     def register_committee(

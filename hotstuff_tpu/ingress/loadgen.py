@@ -32,6 +32,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
+from ..utils import metrics
 from . import messages
 from .admission import IngressConfig
 from .messages import ClientTransaction, IngressResponse
@@ -74,13 +75,9 @@ class ArrivalCurve:
         }
 
 
-def percentile(values: list[float], q: float) -> float:
-    """Nearest-rank percentile, 0.0 on empty input."""
-    if not values:
-        return 0.0
-    ordered = sorted(values)
-    idx = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
-    return ordered[idx]
+# Canonical list-percentile (utils/metrics.py): one definition across
+# loadgen, scheduler LaneStats, and the trace-report tables.
+percentile = metrics.percentile
 
 
 # Fee mix: mostly standard traffic, a slice paying for the priority lane,

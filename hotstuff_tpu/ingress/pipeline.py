@@ -135,15 +135,17 @@ class IngressPipeline:
             msgs = [tx.digest().data for tx, _t0, _f in batch]
             pairs = [(tx.client, tx.signature) for tx, _t0, _f in batch]
             _M_VERIFY_BATCH.record(len(batch))
+            trace = None
             if tracing.enabled():
-                tracing.event(
-                    "ingress.verify",
-                    tracing.trace_id(0, batch[0][0].digest().data),
-                    n=len(batch),
-                )
+                # Batch-head trace id: tags the group's verify.batch event
+                # so trace_report's verify-lane table attributes ingress
+                # queueing delay alongside the consensus lane's.
+                trace = tracing.trace_id(0, batch[0][0].digest().data)
+                tracing.event("ingress.verify", trace, n=len(batch))
             try:
                 mask = await self.service.verify_group(
-                    msgs, pairs, urgent=False, committee=False, dedup=False
+                    msgs, pairs, urgent=False, committee=False, dedup=False,
+                    source="ingress", trace=trace,
                 )
             except Exception as e:
                 # A backend failure must not wedge clients: fail the whole

@@ -103,9 +103,17 @@ class MempoolParameters:
     # Authenticated client ingress (hotstuff_tpu/ingress): when enabled,
     # Mempool.run boots an IngressServer on front_port +
     # ingress_port_offset, feeding verified client transactions into the
-    # same PayloadMaker queue the Front writes.
+    # PayloadMaker's DEDICATED ingress intake lane (the Front keeps its
+    # own lane, so its drop-oldest overflow can never evict an accepted
+    # ingress body — the two planes coexist; scheduler source classes,
+    # ISSUE 7 / ROADMAP item 4).
     ingress_enabled: bool = False
     ingress_port_offset: int = 1_000
+    # Bound on the ingress intake lane into the PayloadMaker. Unlike the
+    # Front's drop-oldest queue, a full ingress lane BLOCKS its producer
+    # (the IngressPipeline drain), which is the backpressure chain that
+    # ends in admission shedding with retry-after.
+    ingress_queue_capacity: int = 2_048
     # Byzantine bound on PayloadRequest serving: at most this many payloads
     # are served per request frame (the prefix; the requester's retry loop
     # fetches the rest). Honest requests cover one block's digests —
@@ -133,6 +141,7 @@ class MempoolParameters:
             "front_queue_capacity": self.front_queue_capacity,
             "ingress_enabled": self.ingress_enabled,
             "ingress_port_offset": self.ingress_port_offset,
+            "ingress_queue_capacity": self.ingress_queue_capacity,
         }
 
     @staticmethod
@@ -149,6 +158,7 @@ class MempoolParameters:
             "front_queue_capacity",
             "ingress_enabled",
             "ingress_port_offset",
+            "ingress_queue_capacity",
         ):
             if k in obj:
                 setattr(p, k, obj[k])

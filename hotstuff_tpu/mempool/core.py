@@ -192,7 +192,7 @@ class Core:
         # the verified-signature cache would otherwise absorb every repeat
         # and the measured rate would be the cache's, not the backend's.
         mask = await self.verification_service.verify_group(
-            msgs, pairs, urgent=False, dedup=False
+            msgs, pairs, urgent=False, dedup=False, source="mempool"
         )
         if not all(mask):
             log.error("synthetic batch verification failed (backend bug?)")

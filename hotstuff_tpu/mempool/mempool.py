@@ -58,6 +58,17 @@ class Mempool:
         )
         sender = NetSender(network_tx, name="mempool-sender")
 
+        # Dedicated ingress intake lane (per-plane PayloadMaker intake,
+        # ISSUE 7): bounded, BLOCKING producer — the opposite admission
+        # contract from the Front's drop-oldest queue above, and what
+        # makes ingress backpressure end-to-end when both planes carry
+        # traffic at once.
+        tx_ingress = (
+            channel(parameters.ingress_queue_capacity)
+            if parameters.ingress_enabled
+            else None
+        )
+
         payload_maker = PayloadMaker(
             name,
             signature_service,
@@ -65,6 +76,7 @@ class Mempool:
             parameters.min_block_delay,
             tx_client,
             core_channel,
+            ingress_in=tx_ingress,
         )
         synchronizer = Synchronizer(
             name,
@@ -99,22 +111,18 @@ class Mempool:
         )
         if parameters.ingress_enabled:
             # Authenticated client plane: signed transactions verify
-            # through the node's shared BatchVerificationService (a
-            # committee-independent lane) before joining the same
-            # PayloadMaker queue the raw Front feeds. CAVEAT: the queue
-            # is shared — with the anonymous Front ALSO receiving
-            # traffic, its drop-oldest overflow can evict ingress bodies
-            # (and its evictions keep freeing slots, so the pipeline's
-            # blocking put rarely exerts backpressure). Run ONE client
-            # plane for real traffic; splitting PayloadMaker intake into
-            # per-plane lanes is the continuous-batching scheduler's job
-            # (ROADMAP item 4).
+            # through the node's shared BatchVerificationService on the
+            # scheduler's ingress lane, then join the PayloadMaker via
+            # their OWN intake queue (tx_ingress). The Front's drop-oldest
+            # overflow stays confined to its lane, so both planes carry
+            # traffic at once without evicting each other's bodies — the
+            # PR 6 shared-queue caveat is resolved by construction.
             from ..ingress.pipeline import IngressPipeline
             from ..ingress.server import IngressServer
 
             IngressServer(
                 ("0.0.0.0", front_addr[1] + parameters.ingress_port_offset),
-                IngressPipeline(core.verification_service, tx_client),
+                IngressPipeline(core.verification_service, tx_ingress),
             )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)

@@ -64,13 +64,16 @@ class Payload:
         return self.signature.verify(self.digest(), self.author)
 
     async def verify_async(self, committee, service) -> bool:
-        """Signature check through the BatchVerificationService. Urgent:
-        consensus blocks on payload AVAILABILITY (MempoolDriver verify ->
-        Wait, consensus/src/mempool.rs:45-60), and a payload is only stored
-        once this check passes — queueing one signature behind a large
-        workload dispatch would stall round progress."""
+        """Signature check through the BatchVerificationService, declared
+        on the scheduler's SYNC lane: consensus blocks on payload
+        AVAILABILITY (MempoolDriver verify -> Wait,
+        consensus/src/mempool.rs:45-60) for both gossiped and sync-
+        re-fetched payloads, so this check must never queue behind a bulk
+        flush timer — the sync class drains first among the batched lanes
+        with a 1 ms deadline, without riding the preemptive critical lane
+        QC/TC checks own."""
         return await service.verify(
-            self.digest().data, self.author, self.signature, urgent=True
+            self.digest().data, self.author, self.signature, source="sync"
         )
 
     def sample_tx_ids(self) -> list[int]:

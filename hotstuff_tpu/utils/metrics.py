@@ -40,6 +40,7 @@ from __future__ import annotations
 import functools
 import json
 import logging
+import math
 import os
 import threading
 import time
@@ -63,6 +64,7 @@ __all__ = [
     "enabled",
     "enable",
     "dump",
+    "percentile",
     "snapshot_json",
     "emit_snapshot",
     "write_json",
@@ -80,6 +82,22 @@ TIME_BUCKETS_S: tuple[float, ...] = (
 # Power-of-two buckets for batch/queue sizes (1 .. 128k — the verifier's
 # bucket widths are powers of two, so each width is its own row).
 SIZE_BUCKETS: tuple[float, ...] = tuple(float(2**i) for i in range(18))
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over raw samples, 0.0 on empty input.
+
+    The ONE list-percentile definition (ceil nearest-rank): ingress
+    loadgen, the scheduler's LaneStats, and tools/trace_report.py's
+    local mirror all report a "p99" computed the same way, so the same
+    samples never yield different percentiles in different reports.
+    (Histogram.percentile interpolates over buckets — a different
+    estimator for pre-binned data, not a duplicate of this.)"""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    idx = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
+    return ordered[idx]
 
 _enabled = os.environ.get("HOTSTUFF_METRICS", "1") != "0"
 
@@ -487,6 +505,24 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("crypto.cpu_batches", "counter", None),
     ("crypto.cpu_sigs", "counter", None),
     ("crypto.batch_size", "histogram", SIZE_BUCKETS),
+    # crypto/scheduler.py — continuous-batching device scheduler. One
+    # queue-delay histogram PER REGISTERED SOURCE CLASS: the starvation
+    # lint (tools/lint_metrics.py) fails if a class in
+    # scheduler.SOURCE_CLASSES has no row here.
+    ("scheduler.submitted", "counter", None),
+    ("scheduler.dispatched_groups", "counter", None),
+    ("scheduler.buckets", "counter", None),
+    ("scheduler.critical_dispatches", "counter", None),
+    ("scheduler.size_flushes", "counter", None),
+    ("scheduler.grid_flushes", "counter", None),
+    ("scheduler.deadline_flushes", "counter", None),
+    ("scheduler.preempt_closes", "counter", None),
+    ("scheduler.depth", "gauge", None),
+    ("scheduler.bucket_size", "histogram", SIZE_BUCKETS),
+    ("scheduler.queue_consensus_s", "histogram", None),
+    ("scheduler.queue_sync_s", "histogram", None),
+    ("scheduler.queue_ingress_s", "histogram", None),
+    ("scheduler.queue_mempool_s", "histogram", None),
     # consensus/core.py + aggregator.py + synchronizer.py
     ("consensus.proposals", "counter", None),
     ("consensus.votes", "counter", None),
@@ -511,6 +547,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("mempool.synthetic_skipped", "counter", None),
     ("mempool.requests_clamped", "counter", None),
     ("mempool.front_dropped", "counter", None),
+    ("mempool.ingress_lane_txs", "counter", None),
     ("mempool.verify_batch_size", "histogram", SIZE_BUCKETS),
     # ingress/ — authenticated client plane with admission control
     ("ingress.received", "counter", None),

@@ -1,0 +1,227 @@
+"""DeviceScheduler: typed source lanes, preemptive critical dispatch,
+alignment-grid bucket sizing, continuous refill, and the legacy-loop
+parity surface `bench.py --scheduler-ab` compares against.
+
+Dependency-free by design (stub backend, no `cryptography`, no jax): the
+scheduler never looks at message bytes, so these tests exercise the real
+admission → bucket → dispatch loop with junk triples.
+"""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.crypto import scheduler as sched
+from hotstuff_tpu.crypto.backend import CryptoBackend
+from hotstuff_tpu.crypto.batch_service import BatchVerificationService
+from hotstuff_tpu.crypto.primitives import PublicKey, Signature
+
+PK = PublicKey(b"\x01" * 32)
+SIG = Signature(b"\x02" * 64)
+
+
+def _group(n: int, tag: bytes = b"m"):
+    msgs = [tag + bytes([i % 256, i // 256]) for i in range(n)]
+    return msgs, [(PK, SIG)] * n
+
+
+class StubBackend(CryptoBackend):
+    """Accept-everything backend that records each dispatch's size; an
+    optional bucket_alignment mimics TpuBackend's device grid."""
+
+    name = "stub"
+
+    def __init__(self, alignment: int = 0):
+        self.calls: list[int] = []
+        if alignment:
+            self.bucket_alignment = alignment
+
+    def verify_batch_mask(self, messages, keys, signatures, **_kw):
+        self.calls.append(len(messages))
+        return [True] * len(messages)
+
+
+def test_resolve_source_mapping():
+    assert sched.resolve_source(None, urgent=True) is sched.CONSENSUS
+    assert sched.resolve_source(None, urgent=False) is sched.MEMPOOL
+    assert sched.resolve_source("ingress", urgent=True) is sched.INGRESS
+    with pytest.raises(ValueError, match="unknown verification source"):
+        sched.resolve_source("nonsense", urgent=False)
+
+
+def test_drain_order_covers_every_registered_class():
+    """The starvation invariant the lint enforces: one group per class,
+    no further arrivals — every class must be selected by the loop."""
+    order = sched.drain_order()
+    assert set(order) == set(sched.SOURCE_CLASSES)
+    # Critical first, then the batched lanes in priority order.
+    assert order[0] == "consensus"
+    assert order.index("sync") < order.index("mempool")
+
+
+def test_critical_groups_coalesce_into_one_flush(run_async):
+    """Simultaneous consensus-critical submissions flush together (the
+    legacy single-queue property the critical lane must keep)."""
+
+    async def body():
+        backend = StubBackend()
+        svc = BatchVerificationService(backend, inline=True)
+        msgs, pairs = _group(1)
+        results = await asyncio.gather(
+            *[
+                svc.verify(msgs[0], PK, SIG, source="consensus")
+                for _ in range(4)
+            ]
+        )
+        assert results == [True] * 4
+        assert svc.stats["flushes"] == 1 and svc.stats["verified"] == 4
+        assert svc.scheduler.stats["critical_dispatches"] == 1
+
+    run_async(body())
+
+
+def test_critical_preempts_forming_bulk_bucket(run_async):
+    """A critical arrival jumps the queue AND closes the forming bulk
+    bucket early: critical dispatches first, the formed bulk ships right
+    behind it instead of waiting out its deadline."""
+
+    async def body():
+        backend = StubBackend()
+        svc = BatchVerificationService(backend, inline=True)
+        bm, bp = _group(100, b"w")
+        w = asyncio.ensure_future(
+            svc.verify_group(bm, bp, source="mempool", dedup=False)
+        )
+        await asyncio.sleep(0.001)  # bulk forming (mempool deadline is 4 ms)
+        cm, cp = _group(3, b"q")
+        u = asyncio.ensure_future(
+            svc.verify_group(cm, cp, source="consensus", dedup=False)
+        )
+        assert all(await u) and all(await w)
+        assert backend.calls == [3, 100], backend.calls
+        assert svc.scheduler.stats["preempt_closes"] == 1
+        # Queue-delay attribution landed on each group's own lane.
+        summary = svc.lane_stats.summary()
+        assert summary["consensus"]["count"] == 1
+        assert summary["mempool"]["count"] == 1
+
+    run_async(body())
+
+
+def test_alignment_grid_bucket_sizing(run_async):
+    """With a device grid of 64, 5×16 pending signatures close a 64-wide
+    bucket (zero pad lanes) and leave the 16-residue to its own deadline
+    flush — the continuous-refill shape."""
+
+    async def body():
+        backend = StubBackend(alignment=64)
+        svc = BatchVerificationService(backend, inline=True)
+        futs = []
+        for i in range(5):
+            m, p = _group(16, b"g%d" % i)
+            futs.append(
+                asyncio.ensure_future(
+                    svc.verify_group(m, p, source="ingress", dedup=False)
+                )
+            )
+        masks = await asyncio.gather(*futs)
+        assert all(all(m) for m in masks)
+        assert backend.calls == [64, 16], backend.calls
+        assert svc.scheduler.stats["buckets"] == 2
+
+    run_async(body())
+
+
+def test_urgent_bit_maps_to_critical_lane(run_async):
+    """Un-migrated callers (urgent=True, no source=) keep riding the
+    preemptive lane — resolve_source's compatibility contract, through
+    the real service."""
+
+    async def body():
+        backend = StubBackend()
+        svc = BatchVerificationService(backend, inline=True)
+        m, p = _group(2)
+        assert await svc.verify_group(m, p, urgent=True, dedup=False) == [True] * 2
+        assert svc.scheduler.lanes["consensus"].dispatched == 1
+        assert svc.scheduler.lanes["mempool"].dispatched == 0
+
+    run_async(body())
+
+
+def test_sync_lane_flushes_before_mempool_deadline(run_async):
+    """A sync group's 1 ms deadline closes the bucket long before the
+    mempool class's 4 ms — and the flush drains lanes in priority order,
+    so the pending mempool group rides along instead of waiting."""
+
+    async def body():
+        backend = StubBackend()
+        svc = BatchVerificationService(backend, inline=True)
+        loop = asyncio.get_running_loop()
+        mm, mp = _group(10, b"b")
+        w = asyncio.ensure_future(
+            svc.verify_group(mm, mp, source="mempool", dedup=False)
+        )
+        sm, sp = _group(1, b"s")
+        t0 = loop.time()
+        ok = await svc.verify(sm[0], PK, SIG, source="sync")
+        took = loop.time() - t0
+        assert ok is True
+        assert took < 0.05, f"sync flush waited {took:.3f}s"
+        assert all(await w)
+        assert backend.calls == [11], backend.calls  # one mixed bucket
+
+    run_async(body())
+
+
+def test_legacy_mode_records_same_lane_attribution(run_async):
+    """use_scheduler=False (the --scheduler-ab baseline) still resolves
+    source classes and feeds the same per-lane queue-delay reservoir, so
+    the A/B compares like with like."""
+
+    async def body():
+        backend = StubBackend()
+        svc = BatchVerificationService(
+            backend, use_scheduler=False, max_delay=0.002, inline=True
+        )
+        assert svc.scheduler is None
+        bm, bp = _group(8, b"b")
+        cm, cp = _group(2, b"c")
+        bulk = asyncio.ensure_future(
+            svc.verify_group(bm, bp, source="mempool", dedup=False)
+        )
+        crit = asyncio.ensure_future(
+            svc.verify_group(cm, cp, source="consensus", dedup=False)
+        )
+        assert all(await crit) and all(await bulk)
+        summary = svc.lane_stats.summary()
+        assert summary["consensus"]["count"] == 1
+        assert summary["mempool"]["count"] == 1
+
+    run_async(body())
+
+
+def test_scheduler_summary_shape(run_async):
+    async def body():
+        svc = BatchVerificationService(StubBackend(), inline=True)
+        m, p = _group(2)
+        await svc.verify_group(m, p, source="ingress", dedup=False)
+        s = svc.scheduler.summary()
+        assert set(s["lanes"]) == set(sched.SOURCE_CLASSES)
+        lane = s["lanes"]["ingress"]
+        assert lane["enqueued"] == 1 and lane["dispatched"] == 1
+        assert lane["depth"] == 0
+        assert "ingress" in s["queue_delay"]
+        assert s["submitted"] == 1
+
+    run_async(body())
+
+
+def test_lane_stats_percentiles():
+    stats = sched.LaneStats()
+    for i in range(100):
+        stats.note("mempool", i / 1000.0)
+    s = stats.summary()["mempool"]
+    assert s["count"] == 100
+    assert 45.0 <= s["p50_ms"] <= 55.0
+    assert 95.0 <= s["p99_ms"] <= 99.0
+    assert s["max_ms"] == 99.0

@@ -1,5 +1,5 @@
 #!/usr/bin/env python3
-"""Metric / trace namespace lint.
+"""Metric / trace namespace lint + scheduler starvation lint.
 
     python tools/lint_metrics.py            # scan hotstuff_tpu/
     python tools/lint_metrics.py --root DIR # scan an arbitrary tree
@@ -18,7 +18,19 @@ grow (a dump must carry EVERY name, zeros included — a name registered
 only at a call site would appear in some processes and not others), and
 keeps the trace-stage vocabulary stable for `tools/trace_report.py`.
 
-Exit codes: 0 = clean, 1 = unknown names found, 2 = usage error.
+The scheduler lint (crypto/scheduler.py) additionally fails rc 1 when:
+
+  * a `source="…"` literal at any `verify_group`/`verify` call site
+    names a class missing from `scheduler.SOURCE_CLASSES` (it would
+    raise at runtime — callers must register, not invent);
+  * a registered class has no `scheduler.queue_<name>_s` row in the
+    canonical namespace (its queueing delay would be invisible); or
+  * a registered class does not DRAIN: the selection logic is simulated
+    over one pending group per class with no further arrivals
+    (`scheduler.drain_order()`), and any class never selected could be
+    enqueued but starve forever.
+
+Exit codes: 0 = clean, 1 = violations found, 2 = usage error.
 """
 
 from __future__ import annotations
@@ -43,9 +55,14 @@ _TRACE_CALL = re.compile(
         \s*\(\s*\n?\s*(?<![fF])["']([^"'{}]+)["']""",
     re.VERBOSE,
 )
+# Declared scheduler source classes at verification call sites
+# (`verify_group(..., source="…")` / `verify(..., source="…")`).
+_SOURCE_KWARG = re.compile(r"""\bsource\s*=\s*["']([^"'{}]+)["']""")
 
 
-def scan_file(path: str, metric_names: set, trace_kinds: set) -> list[str]:
+def scan_file(
+    path: str, metric_names: set, trace_kinds: set, source_classes: set
+) -> list[str]:
     with open(path, encoding="utf-8") as f:
         text = f.read()
     problems = []
@@ -59,10 +76,45 @@ def scan_file(path: str, metric_names: set, trace_kinds: set) -> list[str]:
             problems.append(
                 f"{path}: trace event {kind!r} not in tracing.EVENT_KINDS"
             )
+    for name in _SOURCE_KWARG.findall(text):
+        if name not in source_classes:
+            problems.append(
+                f"{path}: source={name!r} not in scheduler.SOURCE_CLASSES"
+            )
+    return problems
+
+
+def lint_scheduler() -> list[str]:
+    """The starvation lint: every registered source class must (a) own a
+    queue-delay histogram row in the canonical namespace and (b) drain in
+    the scheduler's selection logic (one pending group per class, no
+    further arrivals, simulated clock — `drain_order()` replays the real
+    form_bucket/drain_critical code paths)."""
+    from hotstuff_tpu.crypto import scheduler
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+
+    problems: list[str] = []
+    metric_names = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
+    for name in sorted(scheduler.SOURCE_CLASSES):
+        row = f"scheduler.queue_{name}_s"
+        if row not in metric_names:
+            problems.append(
+                f"scheduler source class {name!r} has no {row!r} histogram "
+                "in metrics._DEFAULT_NAMESPACE (its queueing delay would "
+                "be invisible)"
+            )
+    drained = set(scheduler.drain_order())
+    for name in sorted(set(scheduler.SOURCE_CLASSES) - drained):
+        problems.append(
+            f"scheduler source class {name!r} can be enqueued but is never "
+            "selected by the dispatch loop (starvation — see "
+            "scheduler.drain_order())"
+        )
     return problems
 
 
 def run(root: str) -> list[str]:
+    from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
     from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
     from hotstuff_tpu.utils.tracing import EVENT_KINDS
 
@@ -74,9 +126,12 @@ def run(root: str) -> list[str]:
             if not fn.endswith(".py"):
                 continue
             problems += scan_file(
-                os.path.join(dirpath, fn), metric_names, EVENT_KINDS
+                os.path.join(dirpath, fn),
+                metric_names,
+                EVENT_KINDS,
+                set(SOURCE_CLASSES),
             )
-    return problems
+    return problems + lint_scheduler()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -19,9 +19,18 @@ Outputs:
     that recorded the stage. This is the cross-node attribution the
     per-process metric aggregates cannot answer: "where did block B
     spend its time across the committee".
+  * a **verify-lane table** — per scheduler source class
+    (crypto/scheduler.py), the queueing delay and flush cost distribution
+    aggregated from `verify.batch` events' lane/queue_s tags: the
+    before/after queueing attribution per class.
+  * an **ingress-leg table** — the client path's admission
+    (recv -> admit) and queue+verify (admit -> forward) legs aggregated
+    from `ingress.*` events, plus shed/reject counts (ROADMAP item 3's
+    latency-attribution leftover).
   * with `--chrome PATH`, a Chrome/Perfetto `trace_event` JSON
     (chrome://tracing or https://ui.perfetto.dev) — one process row per
-    node, duration slices for events carrying `dur`, instants otherwise.
+    node (ingress events on their own thread row), duration slices for
+    events carrying `dur`, instants otherwise.
 
 Cross-process clock alignment uses each dump's (mono, wall) anchor pair:
 aligned(t) = anchor.wall - (anchor.mono - t). Dumps from one process (a
@@ -34,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import re
 import sys
 
@@ -138,6 +148,114 @@ def latency_table(blocks: dict, honest: set[str] | None = None) -> str:
     )
 
 
+def _pct_ms(samples: list[float], q: float) -> float:
+    # Mirrors utils/metrics.percentile (ceil nearest-rank) — duplicated
+    # only because this tool must stay stdlib-only; same samples must
+    # yield the same "p99" here as in LaneStats/loadgen summaries.
+    if not samples:
+        return 0.0
+    ordered = sorted(samples)
+    i = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
+    return ordered[i] * 1000.0
+
+
+def verify_lane_table(nodes: list[dict]) -> str:
+    """Per-source-class verification queueing: aggregates the lane /
+    queue_s tags the BatchVerificationService stamps on every traced
+    group's `verify.batch` event. This is the per-class before/after
+    queueing-delay attribution the continuous-batching scheduler exists
+    for (groups: how many traced groups; sigs: their summed sizes)."""
+    lanes: dict[str, dict] = {}
+    for rec in nodes:
+        for e in rec["events"]:
+            if e.get("kind") != "verify.batch":
+                continue
+            data = e.get("data") or {}
+            lane = data.get("lane")
+            if lane is None:
+                continue
+            agg = lanes.setdefault(lane, {"groups": 0, "sigs": 0, "queue": [], "dur": []})
+            agg["groups"] += 1
+            agg["sigs"] += int(data.get("n", 0))
+            agg["queue"].append(float(data.get("queue_s", 0.0)))
+            if e.get("dur") is not None:
+                agg["dur"].append(float(e["dur"]))
+    if not lanes:
+        return ""
+    lines = [
+        "### Verify lanes (scheduler queueing delay per source class)\n",
+        "| lane | groups | sigs | queue p50 (ms) | queue p99 (ms) | flush p50 (ms) | flush p99 (ms) |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for lane in sorted(lanes):
+        a = lanes[lane]
+        lines.append(
+            f"| {lane} | {a['groups']} | {a['sigs']} "
+            f"| {_pct_ms(a['queue'], 0.5):.2f} | {_pct_ms(a['queue'], 0.99):.2f} "
+            f"| {_pct_ms(a['dur'], 0.5):.2f} | {_pct_ms(a['dur'], 0.99):.2f} |"
+        )
+    return "\n".join(lines)
+
+
+def ingress_leg_table(nodes: list[dict]) -> str:
+    """Per-transaction ingress legs, aggregated: admission
+    (ingress.recv -> ingress.admit) and queue+verify
+    (ingress.admit -> ingress.forward — the wait for a verification
+    batch, the batch itself, and the mempool hand-off), plus terminal
+    outcome counts. Events are keyed by each transaction's trace id."""
+    txs: dict[tuple[str, str], dict[str, float]] = {}
+    counts = {"recv": 0, "shed": 0, "reject": 0, "forward": 0}
+    for rec in nodes:
+        for e in rec["events"]:
+            kind = e.get("kind", "")
+            if not kind.startswith("ingress."):
+                continue
+            leg = kind.split(".", 1)[1]
+            if leg in counts:
+                counts[leg] += 1
+            trace = e.get("trace")
+            if trace is None:
+                continue
+            per_tx = txs.setdefault((rec["node"], trace), {})
+            t = e["t"] + rec["offset"]
+            if leg not in per_tx or t < per_tx[leg]:
+                per_tx[leg] = t
+    if not any(txs.values()) and not counts["recv"]:
+        return ""
+    admission = [
+        ts["admit"] - ts["recv"]
+        for ts in txs.values()
+        if "recv" in ts and "admit" in ts
+    ]
+    pipeline = [
+        ts["forward"] - ts["admit"]
+        for ts in txs.values()
+        if "admit" in ts and "forward" in ts
+    ]
+    e2e = [
+        ts["forward"] - ts["recv"]
+        for ts in txs.values()
+        if "recv" in ts and "forward" in ts
+    ]
+    lines = [
+        "### Ingress legs (client-path latency attribution)\n",
+        f"received {counts['recv']}, forwarded {counts['forward']}, "
+        f"shed {counts['shed']}, rejected {counts['reject']}\n",
+        "| leg | txs | p50 (ms) | p99 (ms) |",
+        "|---|---|---|---|",
+    ]
+    for name, samples in (
+        ("admission (recv→admit)", admission),
+        ("queue+verify (admit→forward)", pipeline),
+        ("end-to-end (recv→forward)", e2e),
+    ):
+        lines.append(
+            f"| {name} | {len(samples)} | {_pct_ms(samples, 0.5):.2f} "
+            f"| {_pct_ms(samples, 0.99):.2f} |"
+        )
+    return "\n".join(lines)
+
+
 def chrome_trace(nodes: list[dict]) -> dict:
     """Chrome/Perfetto `trace_event` JSON: one process per node, duration
     slices ("X") for events with dur, thread-scoped instants ("i")
@@ -160,16 +278,28 @@ def chrome_trace(nodes: list[dict]) -> dict:
                 "args": {"name": f"node-{rec['node']}"},
             }
         )
+        # Ingress events ride their own thread row so the client path is
+        # visually separable from the consensus lifecycle lane.
+        events.append(
+            {
+                "ph": "M",
+                "name": "thread_name",
+                "pid": pid,
+                "tid": 1,
+                "args": {"name": "ingress"},
+            }
+        )
         for e in rec["events"]:
             ts = (e["t"] + rec["offset"] - (base or 0.0)) * 1e6
             args = dict(e.get("data") or {})
             if e.get("trace"):
                 args["trace"] = e["trace"]
+            kind = e.get("kind", "?")
             entry = {
-                "name": e.get("kind", "?"),
+                "name": kind,
                 "cat": "hotstuff",
                 "pid": pid,
-                "tid": 0,
+                "tid": 1 if kind.startswith("ingress.") else 0,
                 "args": args,
             }
             dur = e.get("dur")
@@ -215,6 +345,10 @@ def main(argv: list[str] | None = None) -> int:
     print(summarize(nodes))
     print()
     print(latency_table(blocks))
+    for section in (verify_lane_table(nodes), ingress_leg_table(nodes)):
+        if section:
+            print()
+            print(section)
     if args.chrome:
         with open(args.chrome, "w") as f:
             json.dump(chrome_trace(nodes), f, indent=1)
