@@ -145,6 +145,19 @@ def _parse_node(text: str) -> dict:
     out["watchdog_dumps"] = _search_all(
         r"flight recorder dumped to (\S+)", text
     )
+    # Live-telemetry lines (utils/telemetry.py): SLO burn alert
+    # transitions and the periodic device-occupancy line. Occupancy is
+    # cumulative over the timeline ring, so only the LAST line per node
+    # matters.
+    out["slo_fired"] = _search_all(r"SLO burn fired: (\S+)", text)
+    out["slo_cleared"] = _search_all(r"SLO burn cleared: (\S+)", text)
+    occ = _search_all(
+        r"TELEMETRY device occupancy ([\d.]+)% overlap headroom ([\d.]+)%",
+        text,
+    )
+    out["occupancy"] = (
+        (float(occ[-1][0]), float(occ[-1][1])) if occ else None
+    )
     # METRICS snapshot lines (utils/metrics.py periodic emitter). Counters
     # are cumulative, so only the LAST well-formed snapshot per node
     # matters; a malformed blob (truncated by SIGTERM mid-line) is skipped,
@@ -230,6 +243,10 @@ class LogParser:
         self.workload_shed = 0
         self.watchdog_fired: list[str] = []  # anomaly reasons across nodes
         self.watchdog_dumps: list[str] = []  # recorder dump paths
+        self.slo_fired: list[str] = []  # SLO burn alerts across nodes
+        self.slo_cleared: list[str] = []
+        # (occupancy %, overlap headroom %) per node that logged telemetry
+        self.occupancies: list[tuple[float, float]] = []
         # Final METRICS snapshot per node (utils/metrics.py), and the
         # cross-node aggregate (counters summed, histogram count/sum summed).
         self.node_metrics: list[dict] = []
@@ -252,6 +269,10 @@ class LogParser:
             self.workload_shed += r["workload_shed"]
             self.watchdog_fired.extend(r.get("watchdog_fired", []))
             self.watchdog_dumps.extend(r.get("watchdog_dumps", []))
+            self.slo_fired.extend(r.get("slo_fired", []))
+            self.slo_cleared.extend(r.get("slo_cleared", []))
+            if r.get("occupancy") is not None:
+                self.occupancies.append(r["occupancy"])
             if r.get("metrics") is not None:
                 self.node_metrics.append(r["metrics"])
         self.metrics = self._merge_metrics(self.node_metrics)
@@ -400,13 +421,7 @@ class LogParser:
         ingress = ""
         if self.ingress_offered:
             shed_pct = 100.0 * self.ingress_shed / self.ingress_offered
-            # NOT statistics.mean: the histogram loop below shadows the
-            # name `mean` locally, so the import is unbound up here.
-            p50 = (
-                sum(self.ingress_p50s) / len(self.ingress_p50s)
-                if self.ingress_p50s
-                else 0.0
-            )
+            p50 = mean(self.ingress_p50s) if self.ingress_p50s else 0.0
             p99 = max(self.ingress_p99s) if self.ingress_p99s else 0.0
             ingress = (
                 " + INGRESS:\n"
@@ -423,11 +438,14 @@ class LogParser:
                 for name, value in sorted(self.metrics["counters"].items())
                 if value
             ]
+            # h_mean, NOT `mean`: that name is statistics.mean at module
+            # scope, and shadowing it here made the whole function treat
+            # the import as unbound (the PR 6 hand-computed-mean wart).
             for name, h in sorted(self.metrics["histograms"].items()):
                 if h["count"]:
-                    mean = h["sum"] / h["count"]
+                    h_mean = h["sum"] / h["count"]
                     lines.append(
-                        f" {name}: count={h['count']:,} mean={mean:.6g} "
+                        f" {name}: count={h['count']:,} mean={h_mean:.6g} "
                         f"max={h['max']:.6g}"
                     )
             if lines:
@@ -435,6 +453,24 @@ class LogParser:
                     f" + METRICS ({len(self.node_metrics)} node snapshots):\n"
                     + "\n".join(lines)
                     + "\n"
+                )
+        telemetry = ""
+        if self.occupancies or self.slo_fired or self.slo_cleared:
+            telemetry = " + TELEMETRY:\n"
+            if self.occupancies:
+                # Worst node = LOWEST device occupancy (the node whose
+                # device sat idle the most is the one gap attribution
+                # should start from).
+                worst = min(self.occupancies, key=lambda oc: oc[0])
+                telemetry += (
+                    f" Worst-node device occupancy: {worst[0]:.1f} %"
+                    f" (overlap headroom {worst[1]:.1f} %)\n"
+                )
+            if self.slo_fired or self.slo_cleared:
+                names = ", ".join(sorted(set(self.slo_fired))) or "-"
+                telemetry += (
+                    f" SLO burn alerts: {len(self.slo_fired)} fired"
+                    f" ({names}), {len(self.slo_cleared)} cleared\n"
                 )
         warn = ""
         if self.misses:
@@ -473,6 +509,7 @@ class LogParser:
                 else ""
             )
             + ingress
+            + telemetry
             + mtr
             + "-----------------------------------------\n"
         )

@@ -43,7 +43,7 @@ from ..crypto.primitives import Digest, PublicKey, Signature
 from ..crypto.scheduler import SchedulerConfig
 from ..network import net
 from ..store import Store
-from ..utils import metrics, tracing
+from ..utils import metrics, telemetry, tracing
 from ..utils.actors import SpawnScope, channel, spawn
 from .invariants import LivenessChecker, SafetyChecker
 from .plan import FaultPlan, SeededRng
@@ -136,6 +136,7 @@ class ChaosOrchestrator:
         ingress=None,  # ingress.loadgen.IngressLoad | None
         flood: BulkFlood | None = None,
         scheduler_config: SchedulerConfig | None = None,
+        telemetry_config: "telemetry.TelemetryConfig | None" = None,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -176,6 +177,11 @@ class ChaosOrchestrator:
         # Per-node scheduler knobs (e.g. the virtual device-occupancy pace
         # the bulk_flood_priority scenario needs); None = defaults.
         self.scheduler_config = scheduler_config
+        # Live telemetry plane (utils/telemetry.py): one per node when a
+        # config is given — delta snapshots on the virtual clock + SLO
+        # burn-rate alerts, embedded per node in the report.
+        self.telemetry_config = telemetry_config
+        self.telemetry_planes: dict[int, telemetry.TelemetryPlane] = {}
         self.events: list[dict] = []
         self.nodes = [
             _NodeHandle(
@@ -278,6 +284,27 @@ class ChaosOrchestrator:
     async def _drain_ingress(self, sink: asyncio.Queue) -> None:
         while True:
             await sink.get()
+
+    def _boot_telemetry(self, loop) -> None:
+        """One TelemetryPlane per node on the VIRTUAL clock. Planes live
+        in the run scope (an external observer keeps scraping a crashed
+        node) and re-resolve the node's LaneStats through the handle, so
+        a restart's fresh BatchVerificationService is picked up. Per-node
+        LaneStats keep the lane SLO evaluation per node even though the
+        metrics registry is process-global here."""
+        for i in range(self.n):
+            node = self.nodes[i]
+            plane = telemetry.TelemetryPlane(
+                label=i,
+                config=self.telemetry_config,
+                lane_stats=lambda node=node: (
+                    node.service.lane_stats if node.service else None
+                ),
+                clock=loop.time,
+            )
+            plane.attach_watchdog()
+            self.telemetry_planes[i] = plane
+            spawn(plane.run(), name=f"chaos-telemetry-{i}")
 
     def _boot_flood(self) -> None:
         """One open-loop bulk-verification driver per target node (see
@@ -442,15 +469,19 @@ class ChaosOrchestrator:
 
         def _capture(reason: str, detail: dict) -> None:
             # Anomaly-triggered dump, embedded in the report instead of a
-            # file: the chaos report is the artifact of record here.
-            self.watchdog_dumps.append(
-                {
-                    "t": round(loop.time(), 6),
-                    "reason": reason,
-                    "detail": detail,
-                    "events": tracing.RECORDER.events(limit=2_000),
-                }
-            )
+            # file: the chaos report is the artifact of record here. The
+            # watchdog context (each plane's last K telemetry snapshots)
+            # rides along, same as the file-writing auto-dump hook.
+            entry = {
+                "t": round(loop.time(), 6),
+                "reason": reason,
+                "detail": detail,
+                "events": tracing.RECORDER.events(limit=2_000),
+            }
+            ctx = tracing.WATCHDOG.context()
+            if ctx:
+                entry["context"] = ctx
+            self.watchdog_dumps.append(entry)
 
         tracing.WATCHDOG.add_dump_hook(_capture)
         start = loop.time()
@@ -462,6 +493,8 @@ class ChaosOrchestrator:
                     self._boot_ingress()
                 if self.flood is not None:
                     self._boot_flood()
+                if self.telemetry_config is not None:
+                    self._boot_telemetry(loop)
                 if self.plan.crashes:
                     spawn(self._lifecycle(), name="chaos-lifecycle")
                 deadline = start + duration
@@ -483,6 +516,8 @@ class ChaosOrchestrator:
                 await asyncio.gather(*stray, return_exceptions=True)
             net.install_transport(prev_transport)
             set_backend(prev_backend)
+            for plane in self.telemetry_planes.values():
+                plane.detach_watchdog()
             tracing.WATCHDOG.remove_dump_hook(_capture)
             tracing.set_clock(prev_clock)
             if self._own_store_dir:
@@ -521,6 +556,20 @@ class ChaosOrchestrator:
             # Per-node bulk-flood driver counters (BulkFlood scenarios).
             "flood": {
                 str(i): dict(stats) for i, stats in self.flood_stats.items()
+            },
+            # Per-node live-telemetry dumps (snapshot ring + SLO burn
+            # alerts — utils/telemetry.py). `commits` is overwritten with
+            # the per-node truth: the plane's registry view is process-
+            # global here, so its own commit sum would count every node.
+            # tools/telemetry_dash.py renders this section offline, and a
+            # TelemetryServer can serve one node's entry verbatim — the
+            # live scrape and the report then show identical numbers.
+            "telemetry": {
+                str(i): {
+                    **plane.dump(),
+                    "commits": len(self.liveness.commit_times().get(i, ())),
+                }
+                for i, plane in self.telemetry_planes.items()
             },
             # Per-node device-scheduler snapshots: lane depths/dispatch
             # counts and the per-lane queue-delay percentiles the

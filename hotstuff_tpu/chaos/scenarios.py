@@ -20,6 +20,7 @@ from ..crypto.scheduler import SchedulerConfig
 from ..ingress.admission import IngressConfig, LaneSpec
 from ..ingress.loadgen import ArrivalCurve, IngressLoad
 from ..utils import metrics
+from ..utils.telemetry import TelemetryConfig
 from . import vtime
 from .byzantine import Equivocator, SigForger, StaleReplayer, VoteWithholder
 from .orchestrator import BulkFlood, ChaosOrchestrator
@@ -67,6 +68,10 @@ class Scenario:
     # queueing observable under the virtual clock).
     flood: Callable[[], BulkFlood] | None = None
     scheduler: Callable[[], SchedulerConfig] | None = None
+    # Live telemetry plane (utils/telemetry.TelemetryConfig factory): one
+    # per-node snapshot ring + SLO burn evaluator on the virtual clock,
+    # embedded in the report's `telemetry` section.
+    telemetry: Callable[[], TelemetryConfig] | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -429,6 +434,115 @@ _register(
     )
 )
 
+# SLO-burn telemetry: the live-telemetry plane's acceptance scenario
+# (ISSUE 8). A mempool bulk flood overdrives the virtual device-occupancy
+# model (pace 2.2 ms/sig x 40 groups/s x 16 sigs ~= 141% utilization), so
+# bulk queueing delay climbs past the mempool lane's published 500 ms SLO
+# during the flood window; the per-node telemetry planes (0.5 s snapshot
+# interval, 1 s short / 3 s long burn windows) must FIRE the lane.mempool
+# burn alert while the fault is active and CLEAR it after the flood stops
+# and the backlog drains — with the critical lane never burning (the
+# scheduler lane contract, now judged by the evaluator instead of an
+# advisory string).
+_SLO_FLOOD_WINDOW = (1.0, 4.0)
+_SLO_PACE_S_PER_SIG = 0.0022
+
+
+def _slo_telemetry_config() -> TelemetryConfig:
+    return TelemetryConfig(
+        interval_s=0.5,
+        short_window=2,
+        long_window=6,
+        burn_factor=2.0,
+    )
+
+
+def _expect_slo_burn(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "telemetry.snapshots")
+    problems += _expect_counter(deltas, "telemetry.slo_burn_fired")
+    problems += _expect_counter(deltas, "telemetry.slo_burn_cleared")
+    t0, t1 = _SLO_FLOOD_WINDOW
+    if not any(
+        t["reason"] == "slo_burn" for t in report.get("watchdog_triggers", ())
+    ):
+        problems.append(
+            "no slo_burn watchdog trigger (the alert never reached the "
+            "auto-dump path)"
+        )
+    telem = report.get("telemetry", {})
+    if not telem:
+        problems.append("report carries no telemetry section")
+    for label, node in sorted(telem.items()):
+        fired = [
+            a
+            for a in node.get("alerts", ())
+            if a["slo"] == "lane.mempool" and a["event"] == "fired"
+        ]
+        cleared = [
+            a
+            for a in node.get("alerts", ())
+            if a["slo"] == "lane.mempool" and a["event"] == "cleared"
+        ]
+        if not fired:
+            problems.append(
+                f"node {label}: mempool-lane SLO burn never fired under a "
+                "flood that exceeds the lane's 500 ms objective"
+            )
+            continue
+        if not (t0 <= fired[0]["t"] <= t1 + 1.0):
+            problems.append(
+                f"node {label}: burn fired at t={fired[0]['t']}, outside "
+                f"the injected fault window [{t0}, {t1}]"
+            )
+        if not cleared:
+            problems.append(
+                f"node {label}: burn alert never cleared after the flood "
+                "stopped (heal not observed)"
+            )
+        elif cleared[0]["t"] <= t1:
+            problems.append(
+                f"node {label}: burn cleared at t={cleared[0]['t']}, "
+                "before the fault even ended"
+            )
+        if node.get("active_alerts"):
+            problems.append(
+                f"node {label}: alerts still active at run end: "
+                f"{node['active_alerts']}"
+            )
+        # the critical lane must never burn — preemption holds its SLO
+        if any(a["slo"] == "lane.consensus" for a in node.get("alerts", ())):
+            problems.append(
+                f"node {label}: the consensus lane burned its SLO under a "
+                "mempool flood (preemption failed)"
+            )
+    return problems
+
+
+_register(
+    Scenario(
+        name="slo_burn_bulk",
+        description="A mempool bulk flood (~141% virtual device "
+        "utilization) drives bulk queueing past its 500 ms SLO while "
+        "per-node telemetry planes snapshot on the virtual clock: the "
+        "mempool-lane burn-rate alert fires during the flood, the "
+        "consensus lane never burns, and the alert clears after the "
+        "backlog drains — the scrapeable alert surface end to end.",
+        plan=lambda: FaultPlan(default_link=LinkFaults(delay=0.15)),
+        duration=8.0,
+        min_commits=0,  # no early stop: fire AND clear must both play out
+        flood=lambda: BulkFlood(
+            rate=40.0,
+            group_size=16,
+            duration=_SLO_FLOOD_WINDOW[1] - _SLO_FLOOD_WINDOW[0],
+            t_start=_SLO_FLOOD_WINDOW[0],
+            pool=8,
+        ),
+        scheduler=lambda: SchedulerConfig(pace_s_per_sig=_SLO_PACE_S_PER_SIG),
+        telemetry=_slo_telemetry_config,
+        expect=_expect_slo_burn,
+    )
+)
+
 _register(
     Scenario(
         name="saturation_lossy",
@@ -451,6 +565,7 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
+    "telemetry.",
 )
 
 
@@ -479,6 +594,7 @@ def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
             ingress=scenario.ingress() if scenario.ingress else None,
             flood=scenario.flood() if scenario.flood else None,
             scheduler_config=scenario.scheduler() if scenario.scheduler else None,
+            telemetry_config=scenario.telemetry() if scenario.telemetry else None,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

@@ -171,21 +171,58 @@ class LaneStats:
     The global `scheduler.queue_<lane>_s` histograms aggregate across every
     service in the process; chaos scenarios and the bench A/B need
     PER-SERVICE percentiles (one node's critical lane, one A/B leg), so
-    each BatchVerificationService keeps its own bounded sample lists here —
+    each BatchVerificationService keeps its own bounded sample ring here —
     both the scheduler and the legacy flush loop feed it, which is exactly
-    what makes the before/after queueing attribution comparable."""
+    what makes the before/after queueing attribution comparable.
 
-    CAP = 65_536  # samples kept per lane; enough for any bench leg
+    The ring ROTATES at CAP (oldest evicted) rather than saturating: the
+    telemetry plane (utils/telemetry.py) windows per-snapshot deltas off
+    `total()`'s monotonic count, and a saturating list would freeze its
+    live lane SLOs for the rest of the process once a long-running node
+    crossed CAP. `summary()` therefore describes the most recent CAP
+    samples — every bench leg and chaos scenario stays well under that."""
+
+    CAP = 65_536  # samples retained per lane (rotating window)
 
     def __init__(self) -> None:
-        self._samples: dict[str, list[float]] = {
-            name: [] for name in SOURCE_CLASSES
+        self._samples: dict[str, deque] = {
+            name: deque(maxlen=self.CAP) for name in SOURCE_CLASSES
         }
+        self._total: dict[str, int] = {name: 0 for name in SOURCE_CLASSES}
 
     def note(self, lane: str, queue_s: float) -> None:
-        samples = self._samples.setdefault(lane, [])
-        if len(samples) < self.CAP:
-            samples.append(queue_s)
+        ring = self._samples.get(lane)
+        if ring is None:
+            ring = self._samples.setdefault(lane, deque(maxlen=self.CAP))
+        ring.append(queue_s)
+        self._total[lane] = self._total.get(lane, 0) + 1
+
+    def lanes(self) -> list[str]:
+        return list(self._samples)
+
+    def total(self, lane: str) -> int:
+        """Monotonic count of samples EVER noted for the lane — the
+        telemetry plane's cursor basis, immune to ring rotation."""
+        return self._total.get(lane, 0)
+
+    def samples(self, lane: str) -> list[float]:
+        """A copy of the lane's retained samples, oldest first (the last
+        `total() - cursor` entries are the ones a telemetry window has
+        not seen yet)."""
+        return list(self._samples.get(lane, ()))
+
+    def tail(self, lane: str, n: int) -> list[float]:
+        """The most recent min(n, retained) samples, oldest first —
+        O(n), so a telemetry window never pays a full-ring copy just to
+        read a few fresh entries."""
+        ring = self._samples.get(lane)
+        if not ring or n <= 0:
+            return []
+        if n >= len(ring):
+            return list(ring)
+        out = [x for _, x in zip(range(n), reversed(ring))]
+        out.reverse()
+        return out
 
     def summary(self) -> dict[str, dict]:
         """{lane: {count, p50_ms, p99_ms, max_ms}} for lanes that saw work."""

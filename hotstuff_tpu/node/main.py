@@ -69,6 +69,30 @@ async def _run_node(args) -> None:
     if backend is not None:
         node.register_committee(warmup=not args.no_warmup)
     node.boot()
+    if args.telemetry_port is not None:
+        # Live telemetry plane + framed-JSON scrape endpoint
+        # (utils/telemetry.py): periodic delta snapshots over the metrics
+        # registry, per-lane SLO burn evaluation against the node's
+        # LaneStats, and the device-occupancy timeline summary — polled
+        # by tools/telemetry_dash.py. The watchdog attach means every
+        # --trace-out auto-dump embeds the last K snapshots.
+        import os as _os
+
+        from ..ops import timeline
+        from ..utils import telemetry
+        from ..utils.actors import spawn
+
+        plane = telemetry.TelemetryPlane(
+            label=_os.path.splitext(_os.path.basename(args.keys))[0],
+            lane_stats=node.verification_service.lane_stats,
+            timeline_fn=timeline.summary,
+        )
+        plane.attach_watchdog()
+        server = telemetry.TelemetryServer(
+            ("0.0.0.0", args.telemetry_port), plane
+        )
+        server.launch()
+        spawn(plane.run(), name="telemetry-plane")
     await node.analyze_block()
 
 
@@ -173,6 +197,17 @@ def main(argv: list[str] | None = None) -> None:
         "--no-warmup",
         action="store_true",
         help="skip pre-compiling device kernels before joining consensus",
+    )
+    p_run.add_argument(
+        "--telemetry-port",
+        type=int,
+        default=None,
+        metavar="PORT",
+        help="serve the live telemetry scrape endpoint (framed JSON "
+        "request/response on the stack's 4-byte framing): periodic "
+        "metric delta snapshots, SLO burn-rate alerts, lane queueing, "
+        "and the device-occupancy timeline. Poll with "
+        "tools/telemetry_dash.py --poll host:PORT",
     )
     p_run.add_argument(
         "--metrics-out",

@@ -36,6 +36,10 @@ class Node:
         )
         self.store_path = store_path
         self.commit_channel = channel()
+        # Set by boot(): the node's shared BatchVerificationService. The
+        # telemetry plane (node run --telemetry-port) reads its LaneStats
+        # for the per-lane SLO evaluation.
+        self.verification_service = None
 
     def boot(self) -> None:
         """Must run inside an event loop (actors spawn on construction)."""
@@ -50,6 +54,7 @@ class Node:
         from ..crypto.batch_service import BatchVerificationService
 
         verification_service = BatchVerificationService()
+        self.verification_service = verification_service
         consensus_mempool_channel = channel()
         consensus_core_channel = channel()
 

@@ -1,21 +1,47 @@
 """TPU compute kernels: GF(2^255-19) limb arithmetic and batched ed25519
 verification (the reference crypto hot path, crypto/src/lib.rs:194-220,
-rebuilt as JAX SPMD kernels)."""
+rebuilt as JAX SPMD kernels).
+
+The jax-backed submodules (`field`, `ed25519`, ...) load LAZILY (PEP 562):
+`hotstuff_tpu.ops.timeline` — the device-occupancy timeline — and the two
+relay/cache helpers below are dependency-free, and the telemetry plane,
+chaos runner, and tools/lint_metrics.py import them on hosts with no jax
+at all. `from hotstuff_tpu.ops import ed25519 as ed` still works unchanged
+(submodule imports bypass this shim); only attribute access on the package
+goes through __getattr__.
+"""
 
 import os
 
-from . import field
-from .ed25519 import Ed25519TpuVerifier, prepare_batch, prepare_batch_packed
+from . import timeline  # dependency-free; eager on purpose
 
 __all__ = [
     "field",
     "ed25519",
+    "timeline",
     "Ed25519TpuVerifier",
     "prepare_batch",
     "prepare_batch_packed",
     "enable_persistent_cache",
     "check_axon_relay",
 ]
+
+# Package attributes resolved lazily so `import hotstuff_tpu.ops` (and the
+# timeline/telemetry modules) never pull jax.
+_LAZY_MODULES = ("field", "field12", "ed25519", "sha512", "pallas_ladder")
+_LAZY_ED25519 = ("Ed25519TpuVerifier", "prepare_batch", "prepare_batch_packed")
+
+
+def __getattr__(name: str):
+    if name in _LAZY_MODULES:
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    if name in _LAZY_ED25519:
+        from . import ed25519
+
+        return getattr(ed25519, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def check_axon_relay(port: int = 8082, timeout: float = 5.0) -> None:

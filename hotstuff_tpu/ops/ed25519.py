@@ -43,6 +43,7 @@ import numpy as np
 from jax import lax
 
 from . import field as f
+from . import timeline
 from ..utils import metrics
 
 log = logging.getLogger("hotstuff.ops")
@@ -845,16 +846,20 @@ def _uploader() -> "ThreadPoolExecutor":
         return _UPLOADER
 
 
-def _upload_dispatch(fn, padded: np.ndarray, put=None):
+def _upload_dispatch(fn, padded: np.ndarray, put=None, tlkey=None):
     """Runs on the uploader thread: ship one packed chunk, dispatch the
     kernel (async), return the device mask handle. `put` overrides the
     host->device transfer (the mesh verifier shards the batch axis here,
-    so the jitted shard_map never reshards a device-0 array)."""
+    so the jitted shard_map never reshards a device-0 array). `tlkey` is
+    the chunk's (batch, chunk, n) device-timeline key (ops/timeline.py),
+    None when timeline recording is disabled."""
     import jax as _jax
 
-    with metrics.span(_M_UPLOAD):
+    up_span = timeline.span_for("upload", tlkey)
+    di_span = timeline.span_for("dispatch", tlkey)
+    with metrics.span(_M_UPLOAD), up_span:
         dev = (put or _jax.device_put)(padded)
-    with metrics.span(_M_DISPATCH):
+    with metrics.span(_M_DISPATCH), di_span:
         return fn(dev)
 
 
@@ -995,12 +1000,16 @@ class Ed25519TpuVerifier:
     def _run_committee(self, ct, messages, indices, signatures, device_hash: bool):
         n = len(messages)
         up = _uploader()
+        tl_on = timeline.enabled()
+        tl_batch = timeline.TIMELINE.next_batch() if tl_on else 0
         futs, oks, spans = [], [], []
-        for lo in range(0, n, self.chunk):
+        for ci, lo in enumerate(range(0, n, self.chunk)):
             hi = min(lo + self.chunk, n)
             _M_CHUNKS.inc()
             idx_chunk = indices[lo:hi]
-            with metrics.span(_M_STAGE):
+            tlkey = (tl_batch, ci, hi - lo) if tl_on else None
+            st_span = timeline.span_for("stage", tlkey)
+            with metrics.span(_M_STAGE), st_span:
                 if device_hash:
                     staged = prepare_batch_committee_dh(
                         messages[lo:hi], idx_chunk, signatures[lo:hi]
@@ -1021,13 +1030,17 @@ class Ed25519TpuVerifier:
                     _pad(staged["packed"], width),
                     _pad(staged["idx"], width),
                     device_hash,
+                    tlkey,
                 )
             )
             oks.append(staged["s_ok"])
             spans.append((lo, hi, width))
         masks = [fu.result() for fu in futs]
         out = np.empty(n, bool)
-        with metrics.span(_M_READBACK):
+        rb_span = timeline.span_for(
+            "readback", (tl_batch, len(spans) - 1, n) if tl_on else None
+        )
+        with metrics.span(_M_READBACK), rb_span:
             full = self._materialize(masks)
         off = 0
         for (lo, hi, width), ok in zip(spans, oks):
@@ -1036,7 +1049,8 @@ class Ed25519TpuVerifier:
         return out
 
     def _upload_dispatch_committee(
-        self, ct, packed: np.ndarray, idx: np.ndarray, device_hash: bool
+        self, ct, packed: np.ndarray, idx: np.ndarray, device_hash: bool,
+        tlkey=None,
     ):
         """Uploader-thread leg of the committee path: ship the (96, W) wire
         array + (W,) index vector, dispatch against the RESIDENT tables of
@@ -1045,10 +1059,12 @@ class Ed25519TpuVerifier:
         import jax as _jax
 
         put = self._put or _jax.device_put
-        with metrics.span(_M_UPLOAD):
+        up_span = timeline.span_for("upload", tlkey)
+        di_span = timeline.span_for("dispatch", tlkey)
+        with metrics.span(_M_UPLOAD), up_span:
             dev_p = put(packed)
             dev_i = put(idx)
-        with metrics.span(_M_DISPATCH):
+        with metrics.span(_M_DISPATCH), di_span:
             if device_hash:
                 return _verify_w4c96dh_jit(
                     ct.ta_ypx,
@@ -1138,8 +1154,10 @@ class Ed25519TpuVerifier:
         fn = self._packed_dh_fn() if device_hash else self._packed_fn()
         stage = prepare_batch_packed_dh if device_hash else prepare_batch_packed
         up = _uploader()
+        tl_on = timeline.enabled()
+        tl_batch = timeline.TIMELINE.next_batch() if tl_on else 0
         futs, oks, spans = [], [], []
-        for lo in range(0, n, self.chunk):
+        for ci, lo in enumerate(range(0, n, self.chunk)):
             hi = min(lo + self.chunk, n)
             _M_CHUNKS.inc()
             # The generic kernel decompresses every lane's key and rebuilds
@@ -1147,7 +1165,9 @@ class Ed25519TpuVerifier:
             # committee path amortizes away.
             _M_TABLE_BUILDS.inc()
             _M_DECOMPRESSIONS.inc(hi - lo)
-            with metrics.span(_M_STAGE):
+            tlkey = (tl_batch, ci, hi - lo) if tl_on else None
+            st_span = timeline.span_for("stage", tlkey)
+            with metrics.span(_M_STAGE), st_span:
                 staged = stage(
                     messages[lo:hi], keys[lo:hi], signatures[lo:hi]
                 )
@@ -1155,14 +1175,18 @@ class Ed25519TpuVerifier:
             _M_PAD_LANES.inc(width - (hi - lo))
             futs.append(
                 up.submit(
-                    _upload_dispatch, fn, _pad(staged["packed"], width), self._put
+                    _upload_dispatch, fn, _pad(staged["packed"], width),
+                    self._put, tlkey,
                 )
             )
             oks.append(staged["s_ok"])
             spans.append((lo, hi, width))
         masks = [f.result() for f in futs]
         out = np.empty(n, bool)
-        with metrics.span(_M_READBACK):
+        rb_span = timeline.span_for(
+            "readback", (tl_batch, len(spans) - 1, n) if tl_on else None
+        )
+        with metrics.span(_M_READBACK), rb_span:
             full = self._materialize(masks)
         off = 0
         for (lo, hi, width), ok in zip(spans, oks):
@@ -1182,14 +1206,24 @@ class Ed25519TpuVerifier:
         _M_CHUNKS.inc()
         _M_TABLE_BUILDS.inc()
         _M_DECOMPRESSIONS.inc(n)
-        with metrics.span(_M_STAGE):
+        # Legacy f32 path: no separate upload leg (args device_put inside
+        # the jit call), so the timeline records stage/dispatch/readback
+        # and the overlap-headroom pairing has nothing to pair — headroom
+        # honestly reads 0 for a path with no pipelined transfer.
+        tl_on = timeline.enabled()
+        tlkey = (timeline.TIMELINE.next_batch(), 0, n) if tl_on else None
+        st_span = timeline.span_for("stage", tlkey)
+        with metrics.span(_M_STAGE), st_span:
             staged = prepare_batch(
                 messages, keys, signatures, want_bits=self.kernel == "bits"
             )
         width = self._bucket(n)
         _M_PAD_LANES.inc(width - n)
-        mask = _verify_jit_args(staged, width, self.kernel)
-        with metrics.span(_M_READBACK):
+        di_span = timeline.span_for("dispatch", tlkey)
+        with di_span:
+            mask = _verify_jit_args(staged, width, self.kernel)
+        rb_span = timeline.span_for("readback", tlkey)
+        with metrics.span(_M_READBACK), rb_span:
             host = np.asarray(mask)
         return host[:n] & staged["s_ok"]
 

@@ -1,0 +1,326 @@
+"""Device-occupancy timeline: per-chunk (stage, upload, dispatch, readback)
+intervals for host<->device gap attribution.
+
+ROADMAP item 1 claims the committed 9.7x-device vs 4.5x-e2e gap is
+host<->device staging, not kernel math — but the stage histograms in
+utils/metrics.py are AGGREGATES: they can say "upload cost X ms total",
+not "how much of chunk N+1's upload could have hidden under chunk N's
+dispatch". This module records every pipeline phase of the
+Ed25519TpuVerifier chunk loop as an INTERVAL on one monotonic timeline,
+so the three numbers the next perf session needs are measured, not
+asserted:
+
+  * **occupancy** — the fraction of the recorded span in which the
+    device-facing pipeline (upload / dispatch / readback) was busy; the
+    complement is host-only time the device sat idle.
+  * **idle-gap distribution** — the gaps between consecutive busy
+    segments (count / total / p50 / max): how the idle time is shaped
+    (many small bubbles pipeline away; one big bubble is a serialization
+    point).
+  * **overlap headroom** — for consecutive chunks of one batch, the
+    fraction of chunk-N+1 upload time that fits under chunk-N dispatch:
+    sum(min(upload_dur(N+1), dispatch_dur(N))) / sum(upload_dur). This
+    is the number ROADMAP item 1's async double-buffering claim must be
+    judged against — a headroom near 1.0 means a double-buffered
+    dispatch path can hide nearly the whole transfer cost; near 0.0
+    means the transfer is not hideable and the win must come from
+    shrinking it. (Conservative by construction: dispatch intervals time
+    the async issue, so queued device compute behind the issue only adds
+    hideable room this metric does not count.)
+
+Recording is a ring-bounded deque append (oldest evicted), gated on
+`HOTSTUFF_TIMELINE=0` exactly like the metrics/tracing flags; timestamps
+are `time.monotonic()` and dumps carry the flight recorder's (mono, wall)
+anchor pair so `tools/trace_report.py` can align device-timeline rows
+beside the six-stage block rows.
+
+Dependency-free by design: stdlib + utils.metrics/tracing only — no jax
+(`tools/lint_metrics.py` and the chaos/telemetry planes import this
+module on hosts with no accelerator stack at all).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from ..utils import metrics, tracing
+
+__all__ = [
+    "PHASES",
+    "DEVICE_PHASES",
+    "DeviceTimeline",
+    "TIMELINE",
+    "enabled",
+    "enable",
+    "span",
+    "span_for",
+    "NULL",
+    "summary",
+    "dump",
+    "write_json",
+    "reset",
+]
+
+# The four pipeline phases of one verifier chunk, in pipeline order.
+# `stage` is host CPU (numpy/C++ wire-format staging); the other three
+# face the device and define occupancy.
+PHASES: tuple[str, ...] = ("stage", "upload", "dispatch", "readback")
+DEVICE_PHASES: frozenset[str] = frozenset({"upload", "dispatch", "readback"})
+
+_M_INTERVALS = metrics.counter("timeline.intervals")
+_M_DROPPED = metrics.counter("timeline.dropped")
+
+_enabled = os.environ.get("HOTSTUFF_TIMELINE", "1") != "0"
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+class DeviceTimeline:
+    """Ring of (batch, chunk, phase, t0, t1, n) intervals.
+
+    `batch` numbers one verify_batch_mask[_committee] call; `chunk` is the
+    chunk's index within its batch (the uploader is a 1-worker FIFO, so
+    chunk order IS dispatch order). Appends are deque-atomic under the
+    GIL — the staging thread and the uploader thread both record."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get("HOTSTUFF_TIMELINE_RING", "4096"))
+            except ValueError:
+                capacity = 4096
+        self.capacity = max(16, capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._count = 0
+        self._batch_seq = 0
+        self._lock = threading.Lock()
+
+    def next_batch(self) -> int:
+        with self._lock:
+            self._batch_seq += 1
+            return self._batch_seq
+
+    def note(
+        self, batch: int, chunk: int, phase: str, t0: float, t1: float, n: int = 0
+    ) -> None:
+        if not _enabled:
+            return
+        with self._lock:
+            self._count += 1
+        _M_INTERVALS.inc()
+        if self._count > self.capacity:
+            _M_DROPPED.inc()
+        self._ring.append((batch, chunk, phase, t0, t1, n))
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def dropped(self) -> int:
+        return max(0, self._count - self.capacity)
+
+    def intervals(self) -> list[dict]:
+        return [
+            {
+                "batch": b,
+                "chunk": c,
+                "phase": p,
+                "t0": round(t0, 6),
+                "t1": round(t1, 6),
+                "n": n,
+            }
+            for b, c, p, t0, t1, n in list(self._ring)
+        ]
+
+    # -- derived numbers -----------------------------------------------------
+
+    def summary(self) -> dict:
+        """Occupancy / idle-gap / overlap-headroom over the whole ring.
+
+        All fields derive from ONE ring snapshot. Empty ring -> zeros (the
+        shape is stable so BENCH json and dashboards never KeyError)."""
+        iv = list(self._ring)
+        out = {
+            "batches": 0,
+            "chunks": 0,
+            "span_s": 0.0,
+            "occupancy": 0.0,
+            "overlap_headroom": 0.0,
+            "phase_s": {p: 0.0 for p in PHASES},
+            "idle": {"count": 0, "total_s": 0.0, "p50_s": 0.0, "max_s": 0.0},
+        }
+        if not iv:
+            return out
+        t_lo = min(t0 for _b, _c, _p, t0, _t1, _n in iv)
+        t_hi = max(t1 for _b, _c, _p, _t0, t1, _n in iv)
+        phase_s = {p: 0.0 for p in PHASES}
+        busy: list[tuple[float, float]] = []
+        chunks = set()
+        batches = set()
+        upload_dur: dict[tuple[int, int], float] = {}
+        dispatch_dur: dict[tuple[int, int], float] = {}
+        for b, c, p, t0, t1, n in iv:
+            dur = max(0.0, t1 - t0)
+            phase_s[p] = phase_s.get(p, 0.0) + dur
+            chunks.add((b, c))
+            batches.add(b)
+            if p in DEVICE_PHASES:
+                busy.append((t0, t1))
+            if p == "upload":
+                upload_dur[(b, c)] = upload_dur.get((b, c), 0.0) + dur
+            elif p == "dispatch":
+                dispatch_dur[(b, c)] = dispatch_dur.get((b, c), 0.0) + dur
+        # merge the device-busy segments into a union, then read occupancy
+        # and the idle gaps off the merged cover
+        busy.sort()
+        merged: list[list[float]] = []
+        for t0, t1 in busy:
+            if merged and t0 <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], t1)
+            else:
+                merged.append([t0, t1])
+        busy_s = sum(t1 - t0 for t0, t1 in merged)
+        span_s = max(t_hi - t_lo, 1e-12)
+        gaps = [
+            merged[i + 1][0] - merged[i][1]
+            for i in range(len(merged) - 1)
+            if merged[i + 1][0] > merged[i][1]
+        ]
+        # overlap headroom: chunk N+1's upload vs chunk N's dispatch,
+        # paired within one batch (see module docstring)
+        total_upload = sum(upload_dur.values())
+        hideable = sum(
+            min(dur, dispatch_dur.get((b, c - 1), 0.0))
+            for (b, c), dur in upload_dur.items()
+            if c > 0
+        )
+        out.update(
+            {
+                "batches": len(batches),
+                "chunks": len(chunks),
+                "span_s": round(span_s, 6),
+                "occupancy": round(busy_s / span_s, 4),
+                "overlap_headroom": round(
+                    hideable / total_upload if total_upload > 0 else 0.0, 4
+                ),
+                "phase_s": {p: round(s, 6) for p, s in phase_s.items()},
+                "idle": {
+                    "count": len(gaps),
+                    "total_s": round(sum(gaps), 6),
+                    "p50_s": round(metrics.percentile(gaps, 0.50), 6),
+                    "max_s": round(max(gaps), 6) if gaps else 0.0,
+                },
+            }
+        )
+        return out
+
+    def dump(self) -> dict:
+        """Structured artifact; (mono, wall) anchor pair matches the flight
+        recorder's convention so trace_report.py aligns both on one wall
+        timeline."""
+        return {
+            "v": 1,
+            "kind": "device_timeline",
+            "node": tracing.NODE_LABEL.get(),
+            "capacity": self.capacity,
+            "recorded": self._count,
+            "dropped": self.dropped,
+            "anchor": {"mono": time.monotonic(), "wall": time.time()},
+            "intervals": self.intervals(),
+            "summary": self.summary(),
+        }
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.dump(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    def reset(self) -> None:
+        self._ring.clear()
+        self._count = 0
+
+
+TIMELINE = DeviceTimeline()
+
+
+class _Span:
+    """Context manager recording one interval (monotonic enter/exit)."""
+
+    __slots__ = ("_tl", "_batch", "_chunk", "_phase", "_n", "_t0")
+
+    def __init__(self, tl: DeviceTimeline, phase: str, batch: int, chunk: int, n: int):
+        self._tl = tl
+        self._phase = phase
+        self._batch = batch
+        self._chunk = chunk
+        self._n = n
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._tl.note(
+            self._batch, self._chunk, self._phase, self._t0, time.monotonic(), self._n
+        )
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL = _NullSpan()
+
+
+def span(
+    phase: str, batch: int, chunk: int, n: int = 0, timeline: DeviceTimeline | None = None
+):
+    """`with timeline.span("upload", b, c, n): ...` — no-op when disabled."""
+    if not _enabled:
+        return NULL
+    # `is None`, not truthiness: an EMPTY DeviceTimeline is falsy (__len__).
+    return _Span(TIMELINE if timeline is None else timeline, phase, batch, chunk, n)
+
+
+def span_for(phase: str, tlkey: tuple | None):
+    """`span` over the chunk loops' optional (batch, chunk, n) key:
+    NULL when the key is None (their "timeline off" sentinel). One
+    guard here instead of one per call site — and `is None`, so a
+    future falsy key shape cannot silently disable recording."""
+    if tlkey is None:
+        return NULL
+    return span(phase, *tlkey)
+
+
+def summary() -> dict:
+    return TIMELINE.summary()
+
+
+def dump() -> dict:
+    return TIMELINE.dump()
+
+
+def write_json(path: str) -> None:
+    TIMELINE.write_json(path)
+
+
+def reset() -> None:
+    TIMELINE.reset()

@@ -306,16 +306,21 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
         replicated shard_map operands with zero per-batch movement."""
         return ed.CommitteeTable(keys, put=self._replicate)
 
-    def _upload_dispatch_committee(self, ct, packed, idx, device_hash):
+    def _upload_dispatch_committee(self, ct, packed, idx, device_hash, tlkey=None):
         """Uploader-thread leg of the committee path over the mesh: the
         (96, W) wire rows and (W,) index vector land SHARDED on the dp axis
         (no device-0 staging + reshard) and dispatch against the PINNED
         replicated tables of `ct` — a concurrent epoch re-registration must
-        not swap replicas under in-flight sharded chunks."""
-        with metrics.span(ed._M_UPLOAD):
+        not swap replicas under in-flight sharded chunks. `tlkey` threads
+        the chunk's device-timeline key (ops/timeline.py) through, same as
+        the single-chip leg."""
+        tl = ed.timeline
+        up_span = tl.span_for("upload", tlkey)
+        di_span = tl.span_for("dispatch", tlkey)
+        with metrics.span(ed._M_UPLOAD), up_span:
             dev_p = self._put(packed)
             dev_i = self._put_lanes(idx)
-        with metrics.span(ed._M_DISPATCH):
+        with metrics.span(ed._M_DISPATCH), di_span:
             if device_hash:
                 return self._sharded_committee_dh(
                     ct.ta_ypx,

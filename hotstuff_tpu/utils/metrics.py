@@ -65,6 +65,7 @@ __all__ = [
     "enable",
     "dump",
     "percentile",
+    "bucket_percentile",
     "snapshot_json",
     "emit_snapshot",
     "write_json",
@@ -98,6 +99,33 @@ def percentile(values: Sequence[float], q: float) -> float:
     ordered = sorted(values)
     idx = max(0, min(len(ordered) - 1, math.ceil(q * len(ordered)) - 1))
     return ordered[idx]
+
+
+def bucket_percentile(
+    bounds: Sequence[float],
+    counts: Sequence[int],
+    total: int,
+    lo: float,
+    hi: float,
+    q: float,
+) -> float:
+    """Interpolated percentile over bucket counts (the ONE bucket
+    estimator: Histogram.percentile feeds its observed min/max as the
+    edge clamps; the telemetry plane's windowed delta percentiles have no
+    observed range, so they pass [0, last finite bound]). `counts` has
+    one extra overflow entry past `bounds`; `total` is sum(counts),
+    passed in because Histogram reads it under its snapshot lock."""
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c and cum + c >= target:
+            b_lo = float(bounds[i - 1]) if i > 0 else lo
+            b_hi = float(bounds[i]) if i < len(bounds) else hi
+            b_lo = max(b_lo, lo)  # clamp edges to the caller's range
+            b_hi = max(min(b_hi, hi), b_lo)
+            return b_lo + (b_hi - b_lo) * ((target - cum) / c)
+        cum += c
+    return hi
 
 _enabled = os.environ.get("HOTSTUFF_METRICS", "1") != "0"
 
@@ -230,18 +258,7 @@ class Histogram:
         self, counts: list[int], total: int, lo_obs: float, hi_obs: float,
         q: float,
     ) -> float:
-        target = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            if c and cum + c >= target:
-                lo = self.bounds[i - 1] if i > 0 else lo_obs
-                hi = self.bounds[i] if i < len(self.bounds) else hi_obs
-                lo = max(lo, lo_obs)  # clamp edges to the observed range
-                hi = max(min(hi, hi_obs), lo)
-                frac = (target - cum) / c
-                return lo + (hi - lo) * frac
-            cum += c
-        return hi_obs
+        return bucket_percentile(self.bounds, counts, total, lo_obs, hi_obs, q)
 
     def percentile(self, q: float) -> float:
         """q in [0, 1] -> interpolated value; 0.0 on an empty histogram."""
@@ -597,6 +614,15 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("trace.watchdog_triggers", "counter", None),
     ("trace.frames_tagged", "counter", None),
     ("trace.frames_stripped", "counter", None),
+    # utils/telemetry.py — live telemetry plane (delta snapshots, SLO
+    # burn-rate alerts, scrape endpoint)
+    ("telemetry.snapshots", "counter", None),
+    ("telemetry.slo_burn_fired", "counter", None),
+    ("telemetry.slo_burn_cleared", "counter", None),
+    ("telemetry.scrapes", "counter", None),
+    # ops/timeline.py — device-occupancy timeline
+    ("timeline.intervals", "counter", None),
+    ("timeline.dropped", "counter", None),
 )
 
 

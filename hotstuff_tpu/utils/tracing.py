@@ -125,6 +125,8 @@ EVENT_KINDS: frozenset[str] = frozenset(STAGES) | {
     "watchdog.round_stall",
     "watchdog.verify_regression",
     "watchdog.backpressure",
+    "watchdog.slo_burn",
+    "slo.clear",
     "dump",
 }
 
@@ -452,6 +454,12 @@ class AnomalyWatchdog:
             env("HOTSTUFF_TRACE_COOLDOWN_S", "30")
         )
         self._hooks: list[Callable[[str, dict], None]] = []
+        # Context hooks: callables returning extra dict sections merged
+        # into every auto-dump (the telemetry plane registers one so each
+        # <path>.watchdog-<reason>-<n>.json carries the last K metric
+        # snapshots — the trajectory leading up to the trigger, not just
+        # the event ring).
+        self._context_hooks: list[Callable[[], dict]] = []
         self._last_fired: dict[str, float] = {}
         self._bp_since: float | None = None
         self._verify_samples: list[float] = []
@@ -470,6 +478,34 @@ class AnomalyWatchdog:
         except ValueError:
             pass
 
+    def add_context_hook(self, fn: Callable[[], dict]) -> None:
+        self._context_hooks.append(fn)
+
+    def remove_context_hook(self, fn: Callable[[], dict]) -> None:
+        try:
+            self._context_hooks.remove(fn)
+        except ValueError:
+            pass
+
+    def context(self) -> dict:
+        """Merged context sections from every registered hook (dict-valued
+        keys merge shallowly so several telemetry planes can each
+        contribute under one 'telemetry' key); a failing hook is skipped —
+        diagnostics must never take down the dump path."""
+        out: dict = {}
+        for fn in list(self._context_hooks):
+            try:
+                d = fn() or {}
+            except Exception as e:
+                log.warning("watchdog context hook failed: %r", e)
+                continue
+            for k, v in d.items():
+                if isinstance(v, dict) and isinstance(out.get(k), dict):
+                    out[k].update(v)
+                else:
+                    out[k] = v
+        return out
+
     def set_auto_dump(self, path_prefix: str) -> Callable[[str, dict], None]:
         """Install (and return) a hook writing `<prefix>.watchdog-<reason>-<n>.json`
         per trigger."""
@@ -481,6 +517,11 @@ class AnomalyWatchdog:
             try:
                 d = RECORDER.dump()
                 d["watchdog"] = {"reason": reason, **detail}
+                ctx = self.context()
+                if ctx:
+                    # e.g. the telemetry plane's last K snapshots: the
+                    # metric trajectory leading up to the trigger.
+                    d["context"] = ctx
                 with open(path, "w") as f:
                     json.dump(d, f, indent=2, sort_keys=True)
                     f.write("\n")
@@ -540,6 +581,24 @@ class AnomalyWatchdog:
             )
             self._bp_since = None
 
+    def note_slo_burn(
+        self, slo: str, burn_short: float, burn_long: float
+    ) -> None:
+        """An SLO burn-rate alert from the telemetry plane
+        (utils/telemetry.py): both evaluation windows are burning error
+        budget past the configured factor. Fires the `slo_burn` reason
+        (recorder event + auto-dump hooks) under the usual per-reason
+        cooldown — the telemetry plane tracks per-SLO fired/cleared state
+        itself, this is the dump trigger."""
+        if not _enabled:
+            return
+        self._trigger(
+            "slo_burn",
+            slo=slo,
+            burn_short=round(burn_short, 3),
+            burn_long=round(burn_long, 3),
+        )
+
     def note_verify(self, dur_s: float, n: int) -> None:
         if not _enabled or n <= 0:
             return
@@ -570,6 +629,7 @@ class AnomalyWatchdog:
 
     def reset(self) -> None:
         self._last_fired.clear()
+        self._context_hooks = []
         self._bp_since = None
         self._verify_samples = []
         self._verify_baseline = None

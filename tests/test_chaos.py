@@ -82,6 +82,7 @@ _FAST = [
         "leader_crash",
         "flash_crowd_ingress",
         "bulk_flood_priority",
+        "slo_burn_bulk",  # targeted coverage in tests/test_telemetry.py
     )
 ]
 

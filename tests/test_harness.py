@@ -601,6 +601,12 @@ def test_bench_degrades_to_rc0_json_when_relay_unreachable(tmp_path):
     assert body["backend"] in ("cpu-fallback", "error") or "error" in body
     if body["backend"] != "cpu-fallback":
         assert body.get("error")
+    # the host<->device gap-attribution fields (ops/timeline.py) ride
+    # every BENCH json shape, degraded runs included — the junk batch
+    # still exercised the chunk pipeline
+    assert 0.0 <= body["occupancy"] <= 1.0
+    assert 0.0 <= body["overlap_headroom"] <= 1.0
+    assert body["device_timeline"]["chunks"] >= 1
 
 
 # ---------------------------------------------------------------------------
@@ -694,6 +700,32 @@ def test_lint_scheduler_starvation_check_runs():
     assert any("scheduler.queue_ingress_s" in p for p in problems)
 
 
+def test_lint_telemetry_rejects_non_histogram_slo_binding(monkeypatch):
+    """An SLOSpec bound to a registered COUNTER row passes the
+    name-exists check but the burn evaluator would silently never see an
+    event — the lint must name the kind mismatch."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+    import lint_metrics
+
+    from hotstuff_tpu.utils import telemetry
+    from hotstuff_tpu.utils.telemetry import SLOSpec
+
+    assert lint_metrics.lint_telemetry() == []
+    monkeypatch.setattr(
+        telemetry,
+        "default_slos",
+        lambda: (
+            SLOSpec("bad", "telemetry.snapshots", threshold_s=1.0),
+        ),
+    )
+    problems = lint_metrics.lint_telemetry()
+    assert any(
+        "telemetry.snapshots" in p and "counter" in p for p in problems
+    )
+
+
 # ---------------------------------------------------------------------------
 # tools/metrics_report.py: chaos reports render flight-recorder sections
 
@@ -746,3 +778,114 @@ def test_metrics_report_load_accepts_chaos_report(tmp_path):
     d = metrics_report._load(str(path))
     assert d["counters"] == {"chaos.crashes": 1}
     assert "flight_recorders" in d
+
+
+# ---------------------------------------------------------------------------
+# tools/telemetry_dash.py: the live/offline telemetry dashboard
+
+
+_DASH = os.path.join(
+    os.path.dirname(__file__), "..", "tools", "telemetry_dash.py"
+)
+
+
+def _run_dash(*argv):
+    import subprocess
+    import sys
+
+    return subprocess.run(
+        [sys.executable, _DASH, *argv],
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+@pytest.mark.chaos
+def test_telemetry_dash_live_and_offline_render_identical(tmp_path):
+    """The acceptance contract: the dashboard polled over a REAL TCP
+    scrape and the same node's section read out of the chaos report
+    produce identical normalized records — rc 0 + well-formed JSON in
+    both modes. The live side serves the report's telemetry entry
+    verbatim (TelemetryServer dict source), so any divergence is the
+    dashboard's fault, not the workload's."""
+    import json
+
+    from hotstuff_tpu.chaos.scenarios import run_scenario
+    from hotstuff_tpu.utils import telemetry
+
+    report = run_scenario("slo_burn_bulk", seed=11)
+    assert report["ok"], report.get("expectation_failures") or report
+    report_path = tmp_path / "chaos.json"
+    report_path.write_text(json.dumps(report, sort_keys=True, default=str))
+
+    # offline: rc 0, one well-formed record per node, alerts visible
+    proc = _run_dash("--report", str(report_path), "--json")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    offline = json.loads(proc.stdout)
+    assert offline["mode"] == "offline"
+    assert len(offline["nodes"]) == len(report["telemetry"])
+    by_node = {rec["node"]: rec for rec in offline["nodes"]}
+    assert all(rec["alerts_fired"] >= 1 for rec in offline["nodes"])
+    assert all(rec["snapshots"] >= 2 for rec in offline["nodes"])
+
+    # markdown mode also rc 0 (the human path)
+    md = _run_dash("--report", str(report_path))
+    assert md.returncode == 0, md.stderr[-2000:]
+    assert "Telemetry dashboard (offline" in md.stdout
+    assert "SLO burn alerts" in md.stdout
+
+    # live: serve node 0's report entry verbatim and poll it
+    port = telemetry.serve_in_thread(report["telemetry"]["0"])
+    live_proc = _run_dash("--poll", f"127.0.0.1:{port}", "--json")
+    assert live_proc.returncode == 0, live_proc.stderr[-2000:]
+    live = json.loads(live_proc.stdout)
+    assert live["mode"] == "live" and not live["errors"]
+    (live_rec,) = live["nodes"]
+    assert live_rec == by_node[live_rec["node"]]
+
+
+def test_telemetry_dash_rejects_sweep_and_unreachable(tmp_path):
+    """rc 3 on a multi-scenario sweep report (per-node telemetry would be
+    cross-contaminated), rc 2 when a poll target refuses connections."""
+    import json
+
+    sweep = tmp_path / "sweep.json"
+    sweep.write_text(json.dumps({"scenarios": {"baseline": {}}}))
+    assert _run_dash("--report", str(sweep)).returncode == 3
+    proc = _run_dash("--poll", "127.0.0.1:9", "--json", "--timeout", "2")
+    assert proc.returncode == 2
+    assert json.loads(proc.stdout)["errors"]
+
+
+def test_log_parser_scrapes_telemetry_lines():
+    """SLO-burn fired/cleared lines and the periodic device-occupancy
+    line (utils/telemetry.py) fold into the report's `+ TELEMETRY:`
+    section: worst-node occupancy + alert counts. Absent when quiet."""
+    from benchmark.logs import LogParser
+
+    assert "+ TELEMETRY:" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node_a = NODE_LOG + (
+        "[2026-07-30T10:00:05.000Z WARNING hotstuff.telemetry] SLO burn "
+        "fired: lane.mempool (burn 4.0x short / 2.5x long, threshold 0.500s)\n"
+        "[2026-07-30T10:00:09.000Z WARNING hotstuff.telemetry] SLO burn "
+        "cleared: lane.mempool\n"
+        "[2026-07-30T10:00:09.500Z INFO hotstuff.telemetry] TELEMETRY "
+        "device occupancy 61.3% overlap headroom 82.0%\n"
+    )
+    node_b = NODE_LOG + (
+        "[2026-07-30T10:00:02.000Z INFO hotstuff.telemetry] TELEMETRY "
+        "device occupancy 90.0% overlap headroom 10.0%\n"
+        "[2026-07-30T10:00:08.000Z INFO hotstuff.telemetry] TELEMETRY "
+        "device occupancy 44.8% overlap headroom 71.5%\n"
+    )
+    p = LogParser([CLIENT_LOG], [node_a, node_b])
+    assert p.slo_fired == ["lane.mempool"]
+    assert p.slo_cleared == ["lane.mempool"]
+    # per node, only the LAST occupancy line counts (cumulative ring)
+    assert sorted(p.occupancies) == [(44.8, 71.5), (61.3, 82.0)]
+    out = p.result()
+    assert "+ TELEMETRY:" in out
+    assert "Worst-node device occupancy: 44.8 %" in out
+    assert "overlap headroom 71.5 %" in out
+    assert "SLO burn alerts: 1 fired (lane.mempool), 1 cleared" in out

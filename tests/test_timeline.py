@@ -1,0 +1,176 @@
+"""Device-occupancy timeline (ops/timeline.py): summary math, ring
+bounds, jax-free importability, and the wired verifier chunk loop.
+
+The summary-math tests drive a private DeviceTimeline with hand-placed
+intervals so occupancy / idle gaps / overlap headroom are checked against
+numbers computed by hand, not against the implementation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hotstuff_tpu.ops.timeline import DeviceTimeline
+
+
+def _fill(tl: DeviceTimeline, intervals):
+    for batch, chunk, phase, t0, t1, n in intervals:
+        tl.note(batch, chunk, phase, t0, t1, n)
+
+
+def test_summary_empty_ring_is_stable_shape():
+    s = DeviceTimeline(capacity=64).summary()
+    assert s["chunks"] == 0
+    assert s["occupancy"] == 0.0
+    assert s["overlap_headroom"] == 0.0
+    assert set(s["phase_s"]) == {"stage", "upload", "dispatch", "readback"}
+    assert s["idle"] == {"count": 0, "total_s": 0.0, "p50_s": 0.0, "max_s": 0.0}
+
+
+def test_summary_occupancy_and_idle_gaps_hand_computed():
+    tl = DeviceTimeline(capacity=64)
+    # span [0, 10]; device busy [0,2] and [5,6] -> occupancy 0.3; one
+    # idle gap of 3 between them ([6,10] is trailing span from the host
+    # stage below, not an inter-busy gap).
+    _fill(
+        tl,
+        [
+            (1, 0, "upload", 0.0, 1.0, 64),
+            (1, 0, "dispatch", 1.0, 2.0, 64),
+            (1, 0, "readback", 5.0, 6.0, 64),
+            (1, 0, "stage", 9.0, 10.0, 64),  # host phase: not device-busy
+        ],
+    )
+    s = tl.summary()
+    assert s["chunks"] == 1 and s["batches"] == 1
+    assert s["span_s"] == pytest.approx(10.0)
+    assert s["occupancy"] == pytest.approx(0.3)
+    assert s["idle"]["count"] == 1
+    assert s["idle"]["total_s"] == pytest.approx(3.0)
+    assert s["idle"]["max_s"] == pytest.approx(3.0)
+    assert s["phase_s"]["stage"] == pytest.approx(1.0)
+
+
+def test_summary_overlap_headroom_pairs_consecutive_chunks():
+    tl = DeviceTimeline(capacity=64)
+    # chunk 0: dispatch 2s; chunk 1: upload 1s (fully hideable under
+    # chunk 0's dispatch); chunk 2: upload 3s vs chunk 1's 0.5s dispatch
+    # (only 0.5s hideable). chunk 0's own upload (1s) has no predecessor.
+    _fill(
+        tl,
+        [
+            (1, 0, "upload", 0.0, 1.0, 64),
+            (1, 0, "dispatch", 1.0, 3.0, 64),
+            (1, 1, "upload", 3.0, 4.0, 64),
+            (1, 1, "dispatch", 4.0, 4.5, 64),
+            (1, 2, "upload", 4.5, 7.5, 64),
+        ],
+    )
+    s = tl.summary()
+    # hideable = min(1, 2) + min(3, 0.5) = 1.5; total upload = 5
+    assert s["overlap_headroom"] == pytest.approx(1.5 / 5.0)
+    # pairing is per batch: a new batch's chunk 0 pairs with nothing
+    tl.note(2, 0, "upload", 8.0, 9.0, 64)
+    assert tl.summary()["overlap_headroom"] == pytest.approx(1.5 / 6.0)
+
+
+def test_ring_bound_evicts_oldest_and_counts_drops():
+    tl = DeviceTimeline(capacity=16)
+    for i in range(20):
+        tl.note(1, i, "upload", float(i), float(i) + 0.5, 8)
+    assert len(tl) == 16
+    assert tl.dropped == 4
+    assert tl.intervals()[0]["chunk"] == 4  # oldest evicted
+
+
+def test_span_context_manager_records_monotonic_interval():
+    tl = DeviceTimeline(capacity=16)
+    from hotstuff_tpu.ops import timeline as mod
+
+    with mod.span("upload", 3, 1, 42, timeline=tl):
+        pass
+    (iv,) = tl.intervals()
+    assert iv["phase"] == "upload" and iv["batch"] == 3 and iv["chunk"] == 1
+    assert iv["n"] == 42
+    assert iv["t1"] >= iv["t0"]
+
+
+def test_dump_carries_anchor_and_summary(tmp_path):
+    tl = DeviceTimeline(capacity=16)
+    tl.note(1, 0, "upload", 0.0, 1.0, 8)
+    d = tl.dump()
+    assert d["kind"] == "device_timeline"
+    assert {"mono", "wall"} <= set(d["anchor"])
+    assert d["summary"]["chunks"] == 1
+    path = tmp_path / "tl.json"
+    tl.write_json(str(path))
+    assert json.loads(path.read_text())["intervals"][0]["phase"] == "upload"
+
+
+def test_disabled_mode_records_nothing():
+    from hotstuff_tpu.ops import timeline as mod
+
+    tl = DeviceTimeline(capacity=16)
+    mod.enable(False)
+    try:
+        mod.span("upload", 1, 0, 8, timeline=tl).__enter__()
+        tl.note(1, 0, "upload", 0.0, 1.0, 8)
+        assert len(tl) == 0
+    finally:
+        mod.enable(True)
+
+
+def test_verifier_chunk_loop_records_intervals():
+    """The wiring test: a 2-chunk junk batch through the packed pipeline
+    leaves stage/upload/dispatch intervals per chunk plus one readback,
+    and a summary with occupancy in (0, 1]. Junk data on purpose — masks
+    are discarded, the timeline is the subject. Shapes match the width-128
+    w4 family the rest of tier-1 compiles (persistent-cache-shared)."""
+    pytest.importorskip("jax")
+    from hotstuff_tpu.ops import timeline
+    from hotstuff_tpu.ops.ed25519 import Ed25519TpuVerifier
+
+    timeline.TIMELINE.reset()
+    v = Ed25519TpuVerifier(
+        min_bucket=128, max_bucket=128, kernel="w4", chunk=64
+    )
+    v.verify_batch_mask(
+        [os.urandom(32)] * 128, [os.urandom(32)] * 128, [os.urandom(64)] * 128
+    )
+    ivs = timeline.TIMELINE.intervals()
+    assert ivs, "chunk loop recorded nothing"
+    batch = ivs[0]["batch"]
+    seen = {(i["chunk"], i["phase"]) for i in ivs if i["batch"] == batch}
+    for chunk in (0, 1):
+        for phase in ("stage", "upload", "dispatch"):
+            assert (chunk, phase) in seen, (chunk, phase)
+    assert any(i["phase"] == "readback" for i in ivs)
+    s = timeline.TIMELINE.summary()
+    assert s["chunks"] == 2
+    assert 0.0 < s["occupancy"] <= 1.0
+    assert 0.0 <= s["overlap_headroom"] <= 1.0
+
+
+def test_timeline_importable_without_jax():
+    """The lint contract: ops.timeline (and the lazified ops package) must
+    import on a host with no jax at all — DeviceScheduler's rule."""
+    code = (
+        "import sys; sys.modules['jax'] = None; sys.modules['jaxlib'] = None\n"
+        "from hotstuff_tpu.ops import timeline\n"
+        "from hotstuff_tpu.utils import telemetry\n"
+        "assert timeline.summary()['chunks'] == 0\n"
+        "assert len(telemetry.default_slos()) >= 5\n"
+        "print('ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "ok" in proc.stdout

@@ -30,6 +30,18 @@ The scheduler lint (crypto/scheduler.py) additionally fails rc 1 when:
     (`scheduler.drain_order()`), and any class never selected could be
     enqueued but starve forever.
 
+The telemetry lint (utils/telemetry.py) fails rc 1 when:
+
+  * an evaluated `SLOSpec` references a metric missing from the
+    canonical namespace (the burn evaluator would silently see zero
+    events forever); or
+  * a registered scheduler source class has NO SLO in the evaluated set
+    (`telemetry.default_slos()`) — its published slo_s would be back to
+    an advisory string nothing judges.
+
+Both `utils/telemetry.py` and `ops/timeline.py` must stay importable
+without jax (like DeviceScheduler) — this lint runs on jax-less hosts.
+
 Exit codes: 0 = clean, 1 = violations found, 2 = usage error.
 """
 
@@ -113,6 +125,47 @@ def lint_scheduler() -> list[str]:
     return problems
 
 
+def lint_telemetry() -> list[str]:
+    """Every evaluated SLOSpec must bind to a registered metric row, and
+    every registered source class must have an SLO the telemetry plane
+    evaluates (default_slos is the evaluated set of record)."""
+    from hotstuff_tpu.crypto import scheduler
+    from hotstuff_tpu.utils import telemetry
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+
+    problems: list[str] = []
+    metric_kinds = {name: kind for name, kind, _b in _DEFAULT_NAMESPACE}
+    specs = telemetry.default_slos()
+    for spec in specs:
+        kind = metric_kinds.get(spec.metric)
+        if kind is None:
+            problems.append(
+                f"SLOSpec {spec.name!r} references metric {spec.metric!r} "
+                "missing from metrics._DEFAULT_NAMESPACE (the burn "
+                "evaluator would see zero events forever)"
+            )
+        elif kind != "histogram":
+            problems.append(
+                f"SLOSpec {spec.name!r} binds to {spec.metric!r}, a "
+                f"{kind} row — the burn evaluator reads bucketed "
+                "histograms only, so this SLO would silently never see "
+                "an event"
+            )
+        if spec.lane is not None and spec.lane not in scheduler.SOURCE_CLASSES:
+            problems.append(
+                f"SLOSpec {spec.name!r} targets unregistered lane "
+                f"{spec.lane!r}"
+            )
+    covered = {spec.lane for spec in specs if spec.lane is not None}
+    for name in sorted(set(scheduler.SOURCE_CLASSES) - covered):
+        problems.append(
+            f"scheduler source class {name!r} has no SLO in "
+            "telemetry.default_slos() — its slo_s is back to an advisory "
+            "string nothing evaluates"
+        )
+    return problems
+
+
 def run(root: str) -> list[str]:
     from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
     from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
@@ -131,7 +184,7 @@ def run(root: str) -> list[str]:
                 EVENT_KINDS,
                 set(SOURCE_CLASSES),
             )
-    return problems + lint_scheduler()
+    return problems + lint_scheduler() + lint_telemetry()
 
 
 def main(argv: list[str] | None = None) -> int:

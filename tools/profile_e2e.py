@@ -72,6 +72,16 @@ def main() -> None:
         help="write the in-process metrics dump (utils/metrics.py) here — "
         "the spans recorded by the e2e rows, committable next to the table",
     )
+    ap.add_argument(
+        "--timeline",
+        default=None,
+        metavar="OUT_JSON",
+        help="write the device-occupancy timeline dump (ops/timeline.py) "
+        "here: per-chunk stage/upload/dispatch/readback intervals plus "
+        "occupancy / idle-gap / overlap-headroom summary. Feed it to "
+        "tools/trace_report.py --chrome to see transfer/compute overlap "
+        "as device rows in Perfetto",
+    )
     args = ap.parse_args()
 
     import jax
@@ -240,6 +250,24 @@ def main() -> None:
     print(f"# batch={n} chunk={c} chunks={per_chunk} kernel={args.kernel}")
     for r in rows:
         print(r)
+
+    # Device-occupancy attribution (ops/timeline.py): the pipeline-shape
+    # numbers the phase medians above cannot give — how busy the device-
+    # facing pipeline actually was, and how much of the upload cost a
+    # double-buffered dispatch could hide (ROADMAP item 1's go/no-go).
+    from hotstuff_tpu.ops import timeline
+
+    tl = timeline.summary()
+    print(
+        f"# device occupancy {tl['occupancy'] * 100:.1f}%  "
+        f"overlap headroom {tl['overlap_headroom'] * 100:.1f}%  "
+        f"idle gaps {tl['idle']['count']} "
+        f"(p50 {tl['idle']['p50_s'] * 1e3:.2f} ms, "
+        f"max {tl['idle']['max_s'] * 1e3:.2f} ms)"
+    )
+    if args.timeline:
+        timeline.write_json(args.timeline)
+        print(f"# device timeline dump -> {args.timeline}")
 
     if args.metrics_out:
         from hotstuff_tpu.utils import metrics

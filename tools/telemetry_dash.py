@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""Live/offline telemetry dashboard: per-node commit rate, lane queueing,
+device occupancy, and SLO burn alerts — one renderer for both sources.
+
+    # live: scrape N running nodes (node run --telemetry-port / bench.py
+    # --telemetry-port expose the framed-JSON endpoint)
+    python tools/telemetry_dash.py --poll 127.0.0.1:9090,127.0.0.1:9091
+
+    # offline: the same dashboard out of a chaos report's embedded
+    # per-node telemetry section (tools/chaos_run.py --report)
+    python tools/telemetry_dash.py --report chaos.json
+
+    # machine-readable (same normalized records either way)
+    python tools/telemetry_dash.py --report chaos.json --json
+
+Both inputs normalize into one per-node record shape before rendering, so
+a node scraped live and the same node's section read out of a report show
+IDENTICAL numbers (the acceptance contract: a TelemetryServer can serve a
+report's telemetry entry verbatim and this tool cannot tell the
+difference). Reports without a telemetry section degrade to the
+scheduler/commit-times sections, so any chaos report renders something.
+
+Exit codes: 0 = rendered, 2 = a poll target was unreachable, 3 = usage /
+unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def node_record(label: object, dump: dict) -> dict:
+    """Normalize one node's telemetry dump (live scrape response or a
+    report's `telemetry[<node>]` entry) into the record the renderer
+    consumes. Pure function of the dump — the live/offline equivalence
+    the harness test pins."""
+    snaps = dump.get("snapshots") or []
+    span = (
+        float(snaps[-1]["t"]) - float(snaps[0]["t"]) if len(snaps) >= 2 else 0.0
+    )
+    commits = int(dump.get("commits") or 0)
+    alerts = list(dump.get("alerts") or [])
+    lanes = {
+        lane: {
+            "count": int(s.get("count", 0)),
+            "p50_ms": float(s.get("p50_ms", 0.0)),
+            "p99_ms": float(s.get("p99_ms", 0.0)),
+        }
+        for lane, s in (dump.get("lanes") or {}).items()
+    }
+    device = dump.get("device") or {}
+    return {
+        "node": str(dump.get("node") if dump.get("node") is not None else label),
+        "snapshots": len(snaps),
+        "span_s": round(span, 3),
+        "commits": commits,
+        "commit_rate": round(commits / span, 3) if span > 0 else 0.0,
+        "lanes": lanes,
+        "occupancy": device.get("occupancy"),
+        "overlap_headroom": device.get("overlap_headroom"),
+        "active_alerts": list(dump.get("active_alerts") or []),
+        "alerts_fired": sum(1 for a in alerts if a.get("event") == "fired"),
+        "alerts_cleared": sum(1 for a in alerts if a.get("event") == "cleared"),
+        "alerts": alerts,
+    }
+
+
+def records_from_report(report: dict) -> list[dict]:
+    """Per-node records from a chaos report. Prefers the embedded
+    `telemetry` section; degrades to scheduler/commit_times so reports
+    from telemetry-less scenarios still render."""
+    telem = report.get("telemetry") or {}
+    if telem:
+        return [node_record(label, dump) for label, dump in sorted(telem.items())]
+    out = []
+    span = float(report.get("virtual_seconds") or 0.0)
+    sched = report.get("scheduler") or {}
+    commit_times = report.get("commit_times") or {}
+    for label in sorted(set(sched) | set(commit_times)):
+        commits = len(commit_times.get(label, ()))
+        pseudo = {
+            "node": label,
+            "snapshots": [],
+            "commits": commits,
+            "lanes": (sched.get(label) or {}).get("queue_delay", {}),
+            "alerts": [],
+            "active_alerts": [],
+        }
+        rec = node_record(label, pseudo)
+        rec["span_s"] = round(span, 3)
+        rec["commit_rate"] = round(commits / span, 3) if span > 0 else 0.0
+        out.append(rec)
+    return out
+
+
+def records_from_poll(targets: list[str], timeout: float) -> tuple[list[dict], list[str]]:
+    from hotstuff_tpu.utils.telemetry import scrape_sync
+
+    records, errors = [], []
+    for target in targets:
+        host, _, port = target.rpartition(":")
+        if not host or not port.isdigit():
+            errors.append(f"{target}: expected host:port")
+            continue
+        try:
+            dump = scrape_sync((host, int(port)), timeout=timeout)
+        except Exception as e:
+            errors.append(f"{target}: {type(e).__name__}: {e}")
+            continue
+        records.append(node_record(target, dump))
+    return records, errors
+
+
+def _fmt_pct(v) -> str:
+    return f"{v * 100:.1f}%" if isinstance(v, (int, float)) else "-"
+
+
+def _lane_p99(rec: dict, lane: str) -> str:
+    s = rec["lanes"].get(lane)
+    return f"{s['p99_ms']:.1f}" if s else "-"
+
+
+def render_markdown(records: list[dict], mode: str) -> str:
+    lines = [
+        f"### Telemetry dashboard ({mode}, {len(records)} node(s))\n",
+        "| node | commits | commit/s | snaps | crit p99 (ms) | mempool p99 (ms) "
+        "| occupancy | headroom | active alerts | fired/cleared |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for rec in records:
+        active = ", ".join(rec["active_alerts"]) or "-"
+        lines.append(
+            f"| {rec['node']} | {rec['commits']} | {rec['commit_rate']:.2f} "
+            f"| {rec['snapshots']} | {_lane_p99(rec, 'consensus')} "
+            f"| {_lane_p99(rec, 'mempool')} | {_fmt_pct(rec['occupancy'])} "
+            f"| {_fmt_pct(rec['overlap_headroom'])} | {active} "
+            f"| {rec['alerts_fired']}/{rec['alerts_cleared']} |"
+        )
+    alert_lines = []
+    for rec in records:
+        for a in rec["alerts"]:
+            alert_lines.append(
+                f"- node {rec['node']}: {a.get('slo', '?')} "
+                f"{a.get('event', '?')} at t={a.get('t', '?')} "
+                f"(burn {a.get('burn_short', '?')}x short / "
+                f"{a.get('burn_long', '?')}x long)"
+            )
+    if alert_lines:
+        lines += ["", "#### SLO burn alerts", *alert_lines]
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="telemetry_dash", description=__doc__)
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--poll",
+        default=None,
+        help="comma-separated host:port scrape targets (live mode)",
+    )
+    src.add_argument(
+        "--report",
+        default=None,
+        help="chaos report JSON with an embedded telemetry section (offline)",
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="emit the normalized per-node records as one JSON object "
+        "instead of markdown",
+    )
+    ap.add_argument("--timeout", type=float, default=5.0)
+    args = ap.parse_args(argv)
+
+    errors: list[str] = []
+    if args.poll:
+        mode = "live"
+        records, errors = records_from_poll(
+            [t.strip() for t in args.poll.split(",") if t.strip()], args.timeout
+        )
+    else:
+        mode = "offline"
+        try:
+            with open(args.report) as f:
+                report = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{args.report}: {e}", file=sys.stderr)
+            return 3
+        if "scenarios" in report and "telemetry" not in report:
+            print(
+                f"{args.report}: multi-scenario sweep report; re-run "
+                "tools/chaos_run.py with a single --scenario",
+                file=sys.stderr,
+            )
+            return 3
+        records = records_from_report(report)
+
+    if args.json:
+        print(
+            json.dumps(
+                {"mode": mode, "nodes": records, "errors": errors},
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(render_markdown(records, mode))
+        for e in errors:
+            print(f"poll error: {e}", file=sys.stderr)
+    return 2 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,12 +52,32 @@ _BLOCK_TRACE = re.compile(r"^r(\d+)-([0-9a-f]{16})$")
 
 
 def load_inputs(paths: list[str]) -> list[dict]:
-    """Normalize every input into {"node", "offset", "events"} records.
-    `offset` maps the dump's mono clock onto the shared wall timeline."""
+    """Normalize every input into {"node", "offset", "events", "intervals"}
+    records. `offset` maps the dump's mono clock onto the shared wall
+    timeline. Inputs are flight-recorder dumps, ONE chaos report, or
+    device-timeline dumps (ops/timeline.py — `profile_e2e.py --timeline`):
+    a timeline dump contributes per-chunk upload/dispatch/readback
+    interval rows that render beside the six-stage block rows."""
     nodes = []
     for path in paths:
         with open(path) as f:
             d = json.load(f)
+        if d.get("kind") == "device_timeline" or (
+            "intervals" in d and "events" not in d
+        ):
+            anchor = d.get("anchor") or {}
+            offset = float(anchor.get("wall", 0.0)) - float(anchor.get("mono", 0.0))
+            label = d.get("node")
+            nodes.append(
+                {
+                    "node": str(label) if label is not None else path,
+                    "offset": offset,
+                    "events": [],
+                    "intervals": d.get("intervals", []),
+                    "tl_summary": d.get("summary"),
+                }
+            )
+            continue
         if "scenarios" in d and "flight_recorders" not in d:
             # A --scenario all sweep: scenarios reuse node labels and
             # rounds, so stitching them together would corrupt the
@@ -70,7 +90,10 @@ def load_inputs(paths: list[str]) -> list[dict]:
             )
         if "flight_recorders" in d:  # a chaos report: one shared clock
             for label, events in sorted(d["flight_recorders"].items()):
-                nodes.append({"node": label, "offset": 0.0, "events": events})
+                nodes.append(
+                    {"node": label, "offset": 0.0, "events": events,
+                     "intervals": []}
+                )
             continue
         if "events" not in d:
             sys.exit(f"{path}: neither a flight-recorder dump nor a chaos report")
@@ -79,7 +102,10 @@ def load_inputs(paths: list[str]) -> list[dict]:
         label = d.get("node")
         if label is None:
             label = path
-        nodes.append({"node": str(label), "offset": offset, "events": d["events"]})
+        nodes.append(
+            {"node": str(label), "offset": offset, "events": d["events"],
+             "intervals": []}
+        )
     return nodes
 
 
@@ -256,6 +282,34 @@ def ingress_leg_table(nodes: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def device_timeline_table(nodes: list[dict]) -> str:
+    """Per-node device-occupancy summary from device-timeline dumps
+    (ops/timeline.py): occupancy, overlap headroom, idle-gap shape. Uses
+    the dump's embedded summary verbatim so this table shows exactly the
+    numbers the producing process computed (BENCH json, dashboards)."""
+    rows = []
+    for rec in nodes:
+        s = rec.get("tl_summary")
+        if not s:
+            continue
+        idle = s.get("idle", {})
+        rows.append(
+            f"| {rec['node']} | {s.get('chunks', 0)} "
+            f"| {s.get('occupancy', 0.0) * 100:.1f} "
+            f"| {s.get('overlap_headroom', 0.0) * 100:.1f} "
+            f"| {idle.get('count', 0)} | {idle.get('p50_s', 0.0) * 1e3:.2f} "
+            f"| {idle.get('max_s', 0.0) * 1e3:.2f} |"
+        )
+    if not rows:
+        return ""
+    return (
+        "### Device timeline (occupancy & host<->device gap attribution)\n\n"
+        "| node | chunks | occupancy % | overlap headroom % | idle gaps "
+        "| idle p50 (ms) | idle max (ms) |\n"
+        "|---|---|---|---|---|---|---|\n" + "\n".join(rows)
+    )
+
+
 def chrome_trace(nodes: list[dict]) -> dict:
     """Chrome/Perfetto `trace_event` JSON: one process per node, duration
     slices ("X") for events with dur, thread-scoped instants ("i")
@@ -265,6 +319,9 @@ def chrome_trace(nodes: list[dict]) -> dict:
     for rec in nodes:
         for e in rec["events"]:
             t = e["t"] + rec["offset"]
+            base = t if base is None else min(base, t)
+        for iv in rec.get("intervals", ()):
+            t = iv["t0"] + rec["offset"]
             base = t if base is None else min(base, t)
     pids = {}
     for rec in nodes:
@@ -289,6 +346,33 @@ def chrome_trace(nodes: list[dict]) -> dict:
                 "args": {"name": "ingress"},
             }
         )
+        # Device-timeline rows (ops/timeline.py): per-chunk upload/
+        # dispatch/readback slices on their own thread, so transfer vs
+        # compute overlap is visible beside the six-stage block rows.
+        if rec.get("intervals"):
+            events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": 2,
+                    "args": {"name": "device"},
+                }
+            )
+            for iv in rec["intervals"]:
+                ts = (iv["t0"] + rec["offset"] - (base or 0.0)) * 1e6
+                events.append(
+                    {
+                        "name": f"{iv['phase']} b{iv['batch']}c{iv['chunk']}",
+                        "cat": "device",
+                        "ph": "X",
+                        "pid": pid,
+                        "tid": 2,
+                        "ts": ts,
+                        "dur": max(0.0, (iv["t1"] - iv["t0"]) * 1e6),
+                        "args": {"n": iv.get("n", 0), "phase": iv["phase"]},
+                    }
+                )
         for e in rec["events"]:
             ts = (e["t"] + rec["offset"] - (base or 0.0)) * 1e6
             args = dict(e.get("data") or {})
@@ -345,7 +429,11 @@ def main(argv: list[str] | None = None) -> int:
     print(summarize(nodes))
     print()
     print(latency_table(blocks))
-    for section in (verify_lane_table(nodes), ingress_leg_table(nodes)):
+    for section in (
+        verify_lane_table(nodes),
+        ingress_leg_table(nodes),
+        device_timeline_table(nodes),
+    ):
         if section:
             print()
             print(section)
