@@ -152,10 +152,21 @@ class BatchVerificationService:
         inline: bool = False,
         use_scheduler: bool = True,
         scheduler_config: SchedulerConfig | None = None,
+        steal_backends: Sequence[CryptoBackend] | None = None,
     ) -> None:
         self._backend = backend
         self.max_batch = max_batch
         self.max_delay = max_delay
+        # Cross-chip work stealing (crypto/scheduler.py): sibling shard
+        # backends bulk buckets may be stolen to when the home backend's
+        # pipeline window is full. Backend 0 (the `backend` arg) stays
+        # home for every critical dispatch and all legacy-loop flushes.
+        # inline=True (the chaos virtual-time mode) FORCES stealing off:
+        # which backend a bucket lands on must not depend on wall-clock
+        # thread timing when a scenario replays bit-for-bit (§5.5i).
+        self._steal_backends: list[CryptoBackend] = (
+            [] if inline else list(steal_backends or ())
+        )
         # inline=True runs the backend call ON the event loop instead of a
         # worker thread. Production keeps the thread (a TPU dispatch must
         # not block consensus timers); the chaos runner opts in because its
@@ -183,6 +194,7 @@ class BatchVerificationService:
                 alignment_fn=self._bucket_alignment,
                 config=scheduler_config,
                 lane_stats=self.lane_stats,
+                n_backends=1 + len(self._steal_backends),
             )
             if use_scheduler
             else None
@@ -191,8 +203,21 @@ class BatchVerificationService:
         # check must not wait out a multi-thousand-signature workload batch
         # already in flight on the device (backends route small batches to
         # the CPU fast path, so the urgent flush completes in microseconds
-        # while the big dispatch is still on the wire).
-        self._dispatch_sem = asyncio.Semaphore(max_concurrent_dispatches)
+        # while the big dispatch is still on the wire; urgent dispatches
+        # never acquire this semaphore). With steal backends configured
+        # the bound must cover every backend window the scheduler can
+        # legitimately fill (bulk_concurrency per backend) — otherwise
+        # the service-global semaphore silently caps stealing below the
+        # per-backend accounting that admitted it. Without steal
+        # backends the caller's max_concurrent_dispatches stands as-is.
+        dispatch_bound = max_concurrent_dispatches
+        if self.scheduler is not None and self._steal_backends:
+            dispatch_bound = max(
+                dispatch_bound,
+                self.scheduler.config.bulk_concurrency
+                * (1 + len(self._steal_backends)),
+            )
+        self._dispatch_sem = asyncio.Semaphore(dispatch_bound)
         self._dispatches: set[asyncio.Task] = set()
         self.stats = {
             "flushes": 0,
@@ -358,23 +383,38 @@ class BatchVerificationService:
                 self._spawn_dispatch(groups, total, False)
 
     def _spawn_dispatch(
-        self, groups: list[_Group], total: int, urgent: bool
+        self, groups: list[_Group], total: int, urgent: bool,
+        backend_idx: int = 0,
     ) -> asyncio.Task:
         from ..utils.actors import spawn
 
-        task = spawn(self._dispatch(groups, total, urgent), name="verify-dispatch")
+        task = spawn(
+            self._dispatch(groups, total, urgent, backend_idx),
+            name="verify-dispatch",
+        )
         self._dispatches.add(task)
         task.add_done_callback(self._dispatches.discard)
         return task
 
-    async def _dispatch(self, groups: list[_Group], total: int, urgent: bool) -> None:
+    async def _dispatch(
+        self, groups: list[_Group], total: int, urgent: bool,
+        backend_idx: int = 0,
+    ) -> None:
         if not urgent:
             await self._dispatch_sem.acquire()
         try:
             msgs = [m for g in groups for m in g.messages]
             keys = [k for g in groups for k in g.keys]
             sigs = [s for g in groups for s in g.signatures]
-            backend = self.backend
+            # backend_idx > 0 is a scheduler steal: the bucket rides a
+            # sibling shard's pipeline. Committee routing still resolves
+            # per backend (an unregistered steal target just takes the
+            # generic kernel — correctness never depends on the tag).
+            backend = (
+                self.backend
+                if backend_idx == 0
+                else self._steal_backends[backend_idx - 1]
+            )
 
             # Verified-signature dedup: triples the aggregator (or an
             # earlier flush) already validated resolve True without

@@ -34,6 +34,16 @@ class and latency SLO, feed one admission → bucket → dispatch loop:
     heterogeneous batches. Buckets are lane-ordered (sync before ingress
     before mempool) but may mix classes — per-group queueing delay is
     attributed to each group's own lane regardless.
+  * **Cross-chip work stealing** (`n_backends > 1`). The owning service
+    may register sibling shard backends (one TpuBackend per chip/mesh
+    leg): each backend gets its own `bulk_concurrency` in-flight account
+    mirroring its DispatchPipeline window (ops/pipeline.py), and a bulk
+    bucket dispatches to the FIRST backend with a free slot, home (0)
+    preferred — one service no longer feeds one backend while sibling
+    pipelines idle. A non-home dispatch counts into `pipeline.steals`.
+    Critical work always rides home (the committee-registered backend).
+    Chaos/virtual-time services run `inline=True`, which forces
+    n_backends=1 — bit-identical to the pre-stealing loop.
 
 The scheduler owns admission, per-lane queueing, and bucket formation;
 the owning BatchVerificationService stays the dispatch executor (dedup
@@ -136,6 +146,11 @@ def resolve_source(source: str | None, urgent: bool) -> SourceClass:
 
 
 _M_SUBMITTED = metrics.counter("scheduler.submitted")
+# Cross-chip work stealing (ISSUE 9 / ROADMAP items 1+4): a bulk bucket
+# dispatched to any backend other than the home backend 0 counts here —
+# the pipeline.* namespace because the free-slot model mirrors each
+# backend's DispatchPipeline window (ops/pipeline.py).
+_M_STEALS = metrics.counter("pipeline.steals")
 _M_DISPATCHED = metrics.counter("scheduler.dispatched_groups")
 _M_BUCKETS = metrics.counter("scheduler.buckets")
 _M_CRITICAL = metrics.counter("scheduler.critical_dispatches")
@@ -283,6 +298,7 @@ class DeviceScheduler:
         config: SchedulerConfig | None = None,
         lane_stats: LaneStats | None = None,
         classes: tuple[SourceClass, ...] | None = None,
+        n_backends: int = 1,
     ) -> None:
         self._dispatch = dispatch
         self.max_batch = max_batch
@@ -294,14 +310,39 @@ class DeviceScheduler:
         self._critical = [c.name for c in ordered if c.preemptive]
         self._batched = [c.name for c in ordered if not c.preemptive]
         self.lanes: dict[str, _Lane] = {c.name: _Lane(c) for c in ordered}
-        self._inflight_bulk = 0
+        # Cross-chip work stealing: one bulk in-flight account per
+        # dispatch target. Backend 0 is HOME (the committee-registered
+        # primary every critical dispatch rides); targets 1..n-1 are the
+        # steal shards — a bulk bucket goes to the first backend with a
+        # free slot, home preferred, so one service no longer feeds one
+        # backend while sibling pipelines idle. `bulk_concurrency` slots
+        # per backend mirror each backend's DispatchPipeline window.
+        # With n_backends == 1 the accounting and the dispatch-hook
+        # arity are EXACTLY the pre-stealing behavior (the chaos
+        # inline/virtual-time determinism contract, §5.5i).
+        self.n_backends = max(1, n_backends)
+        self._inflight = [0] * self.n_backends
         self._wake: asyncio.Event | None = None  # bound lazily to the loop
         self.stats = {
             "submitted": 0,
             "buckets": 0,
             "critical_dispatches": 0,
             "preempt_closes": 0,
+            "steals": 0,
         }
+
+    @property
+    def _inflight_bulk(self) -> int:
+        """Total bulk dispatches in flight across every backend."""
+        return sum(self._inflight)
+
+    def _pick_backend(self) -> int | None:
+        """First backend with a free bulk slot, home (0) preferred; None
+        while every pipeline window is full (the loop then waits)."""
+        for idx in range(self.n_backends):
+            if self._inflight[idx] < self.config.bulk_concurrency:
+                return idx
+        return None
 
     # -- admission -----------------------------------------------------------
 
@@ -407,10 +448,11 @@ class DeviceScheduler:
 
     # -- dispatch loop -------------------------------------------------------
 
-    def note_bulk_done(self, _task=None) -> None:
-        """Done-callback for non-critical dispatch tasks: frees a bulk slot
-        and wakes the loop so the next bucket can ship (continuous refill)."""
-        self._inflight_bulk -= 1
+    def note_bulk_done(self, _task=None, backend: int = 0) -> None:
+        """Done-callback for non-critical dispatch tasks: frees the
+        backend's bulk slot and wakes the loop so the next bucket can
+        ship (continuous refill)."""
+        self._inflight[backend] -= 1
         if self._wake is not None:
             self._wake.set()
 
@@ -458,10 +500,14 @@ class DeviceScheduler:
             # 1. Critical lane first, always; remember whether it preempted
             #    a forming (non-empty, not-yet-closed) batched backlog.
             preempted = self._ship_critical(now)
-            # 2. One batched bucket, if a slot is free and a close condition
-            #    holds (a preempt close ships the formed groups immediately
-            #    so the critical jump never re-delays them).
-            if self._inflight_bulk < self.config.bulk_concurrency:
+            # 2. One batched bucket, if any backend has a free slot and a
+            #    close condition holds (a preempt close ships the formed
+            #    groups immediately so the critical jump never re-delays
+            #    them). Home backend preferred; a bucket shipped to a
+            #    sibling shard while home's pipeline window is full is a
+            #    STEAL (pipeline.steals).
+            target = self._pick_backend()
+            if target is not None:
                 formed = self.form_bucket(now, force=preempted)
                 if formed is not None:
                     bucket, reason = formed
@@ -480,9 +526,21 @@ class DeviceScheduler:
                         _M_GRID_FLUSHES.inc()
                     else:
                         _M_DEADLINE_FLUSHES.inc()
-                    self._inflight_bulk += 1
-                    task = self._dispatch(bucket, total, False)
-                    task.add_done_callback(self.note_bulk_done)
+                    self._inflight[target] += 1
+                    if target != 0:
+                        self.stats["steals"] += 1
+                        _M_STEALS.inc()
+                    if self.n_backends == 1:
+                        # Pre-stealing arity: single-backend dispatch
+                        # hooks (and the lint's drain-order stub) never
+                        # see a target index.
+                        task = self._dispatch(bucket, total, False)
+                        task.add_done_callback(self.note_bulk_done)
+                    else:
+                        task = self._dispatch(bucket, total, False, target)
+                        task.add_done_callback(
+                            lambda t, b=target: self.note_bulk_done(t, b)
+                        )
                     if pace > 0.0:
                         # Virtual device-occupancy model (chaos): the bulk
                         # pipeline is busy for total*pace seconds — but the
@@ -500,7 +558,7 @@ class DeviceScheduler:
             if self.depth() > 0 and self._ship_critical(loop.time()):
                 continue  # raced a critical submit against the clear
             deadline = self._next_deadline()
-            waitable = self._inflight_bulk < self.config.bulk_concurrency
+            waitable = self._pick_backend() is not None
             timeout = None
             if deadline is not None and waitable:
                 timeout = max(0.0, deadline - loop.time())
@@ -512,6 +570,8 @@ class DeviceScheduler:
     def summary(self) -> dict:
         """Structured per-lane snapshot (chaos reports embed one per node)."""
         return {
+            "backends": self.n_backends,
+            "inflight": list(self._inflight),
             "lanes": {
                 name: {
                     "priority": lane.cls.priority,

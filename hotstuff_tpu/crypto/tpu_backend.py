@@ -125,6 +125,14 @@ class TpuBackend(CryptoBackend):
         self._lock = threading.Lock()
         self.stats = {"tpu_batches": 0, "tpu_sigs": 0, "cpu_batches": 0, "cpu_sigs": 0}
 
+    def close(self) -> None:
+        """Drain the verifier's dispatch-pipeline workers (ops/pipeline.py).
+        Optional — dropped backends are reaped by GC/atexit — but a tidy
+        shutdown path for tests and per-shard steal backends."""
+        closer = getattr(self._verifier, "close", None)
+        if closer is not None:
+            closer()
+
     @property
     def bucket_alignment(self) -> int:
         """The device bucket grid: `lane * ndev` on a mesh

@@ -320,6 +320,14 @@ def main(argv: list[str] | None = None) -> None:
 
         flushers.append(_dump_profile)
 
+    if args.command == "run":
+        # Drain every live DispatchPipeline's workers on SIGTERM too —
+        # the handler below exits via os._exit, which skips the
+        # pipeline's own atexit hook (ops/pipeline.py close_all).
+        from ..ops.pipeline import close_all as _drain_pipelines
+
+        flushers.append(_drain_pipelines)
+
     if flushers:
         import atexit
         import signal

@@ -3,7 +3,8 @@ verification (the reference crypto hot path, crypto/src/lib.rs:194-220,
 rebuilt as JAX SPMD kernels).
 
 The jax-backed submodules (`field`, `ed25519`, ...) load LAZILY (PEP 562):
-`hotstuff_tpu.ops.timeline` — the device-occupancy timeline — and the two
+`hotstuff_tpu.ops.timeline` (device-occupancy timeline) and
+`hotstuff_tpu.ops.pipeline` (async dispatch pipeline) plus the two
 relay/cache helpers below are dependency-free, and the telemetry plane,
 chaos runner, and tools/lint_metrics.py import them on hosts with no jax
 at all. `from hotstuff_tpu.ops import ed25519 as ed` still works unchanged
@@ -13,11 +14,12 @@ goes through __getattr__.
 
 import os
 
-from . import timeline  # dependency-free; eager on purpose
+from . import pipeline, timeline  # dependency-free; eager on purpose
 
 __all__ = [
     "field",
     "ed25519",
+    "pipeline",
     "timeline",
     "Ed25519TpuVerifier",
     "prepare_batch",

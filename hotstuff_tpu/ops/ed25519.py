@@ -33,8 +33,6 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
 import jax
@@ -44,6 +42,7 @@ from jax import lax
 
 from . import field as f
 from . import timeline
+from .pipeline import ChunkTask, DispatchPipeline
 from ..utils import metrics
 
 log = logging.getLogger("hotstuff.ops")
@@ -826,33 +825,25 @@ def _pad(arr: np.ndarray, width: int) -> np.ndarray:
     return np.pad(arr, cfg)
 
 
-_UPLOADER: "ThreadPoolExecutor | None" = None
-_UPLOADER_LOCK = threading.Lock()
-
-
-def _uploader() -> "ThreadPoolExecutor":
-    """One shared background thread for host->device uploads + dispatches.
+def _upload_dispatch(fn, padded: np.ndarray, put=None, tlkey=None):
+    """Runs on the pipeline's upload worker: ship one packed chunk,
+    dispatch the kernel (async), return the device mask handle. `put`
+    overrides the host->device transfer (the mesh verifier shards the
+    batch axis here, so the jitted shard_map never reshards a device-0
+    array). `tlkey` is the chunk's (batch, chunk, n) device-timeline key
+    (ops/timeline.py), None when timeline recording is disabled.
 
     Measured on a tunneled chip: issuing device_put from the main thread
     serializes transfers with kernel execution (one RPC stream), while a
-    second thread overlaps them (~1.5x e2e). A single worker keeps chunk
-    order (FIFO executor queue) and avoids RPC contention from parallel
-    transfers, which measurably degrades tunnel bandwidth.
-    """
-    global _UPLOADER
-    with _UPLOADER_LOCK:
-        if _UPLOADER is None:
-            _UPLOADER = ThreadPoolExecutor(1, thread_name_prefix="tpu-upload")
-        return _UPLOADER
-
-
-def _upload_dispatch(fn, padded: np.ndarray, put=None, tlkey=None):
-    """Runs on the uploader thread: ship one packed chunk, dispatch the
-    kernel (async), return the device mask handle. `put` overrides the
-    host->device transfer (the mesh verifier shards the batch axis here,
-    so the jitted shard_map never reshards a device-0 array). `tlkey` is
-    the chunk's (batch, chunk, n) device-timeline key (ops/timeline.py),
-    None when timeline recording is disabled."""
+    second thread overlaps them (~1.5x e2e). Each verifier's
+    DispatchPipeline has ONE upload worker, keeping chunk order (FIFO
+    executor queue) and avoiding parallel-transfer RPC contention WITHIN
+    a verifier — but the serialization is per-pipeline now, not
+    process-global: cross-chip work stealing (§5.5i) deliberately runs
+    sibling backends' uploads in parallel, on the assumption that
+    distinct chips ride distinct links/RPC streams. Steal targets
+    sharing ONE tunneled stream will contend; measure before enabling
+    stealing on a shared tunnel."""
     import jax as _jax
 
     up_span = timeline.span_for("upload", tlkey)
@@ -868,13 +859,15 @@ class Ed25519TpuVerifier:
 
     Batches are padded up to power-of-two lane widths (>= 128 so the lane
     dimension is full) to bound the number of XLA compilations; oversize
-    batches are split at `chunk` and PIPELINED: each chunk ships as a packed
-    (128, W) u8 wire array (`prepare_batch_packed`) and is uploaded +
-    dispatched from a background thread, so host staging of chunk k+1
-    overlaps the transfer of chunk k and the device compute of chunk k-1;
-    all chunk masks are fetched in ONE device->host readback (per-transfer
-    latency is paid once, not per chunk — decisive over low-bandwidth/
-    tunneled links).
+    batches are split at `chunk` and ride an owned `DispatchPipeline`
+    (ops/pipeline.py): each chunk ships as a packed (128, W) u8 wire array
+    (`prepare_batch_packed`) packed into a REUSED staging buffer, uploaded
+    + dispatched from the pipeline's FIFO upload worker while the NEXT
+    chunk stages, and its mask is fetched on the streaming readback worker
+    while the next chunk dispatches — a bounded window of `pipeline_depth`
+    chunks (default 2 = double buffering) is in flight between staging and
+    readback. `pipeline_depth=1` is the serial/inline mode: no worker
+    threads, deterministic order (the chaos rule, COMPONENTS.md §5.5i).
 
     `packed=False` restores the f32 argument path (used by the sharded
     mesh verifier and the legacy bit-ladder kernel).
@@ -893,6 +886,7 @@ class Ed25519TpuVerifier:
         kernel: str = "w4",
         packed: bool | None = None,
         chunk: int | None = None,
+        pipeline_depth: int | None = None,
     ):
         self.kernel = kernel
         if kernel == "pallas":
@@ -905,7 +899,23 @@ class Ed25519TpuVerifier:
         self.max_bucket = max_bucket
         self.packed = packed if packed is not None else kernel != "bits"
         self.chunk = min(chunk or 4096, max_bucket)
+        # The owned dispatch pipeline (ops/pipeline.py): bounded in-flight
+        # window, pooled staging buffers, streamed per-chunk readback.
+        # Lazy threads — constructing a verifier spawns nothing; close()
+        # (or GC, or atexit) reaps whatever a run created.
+        self.pipeline = DispatchPipeline(
+            depth=pipeline_depth, name=f"ed25519-{kernel}"
+        )
         self._put = None  # optional device_put override (mesh sharding)
+        # Deferred readback (multi-process mesh, parallel/mesh.py): the
+        # per-chunk readback returns the raw device handle and the chunk
+        # loop materializes ALL handles in one end-of-batch
+        # `_materialize` call — a single allgather instead of one
+        # collective per chunk, the pre-pipeline multihost shape. Stage
+        # then pads into FRESH buffers (jax keeps the host array alive
+        # through the async transfer) because nothing blocks per chunk to
+        # mark a pooled buffer reusable.
+        self._defer_readback = False
         # Device-hash health latch: if the SHA-512/mod-L kernel ever fails
         # at runtime (an unexpected backend lowering gap would otherwise
         # take down every verification), fall back to host hashing for the
@@ -999,53 +1009,72 @@ class Ed25519TpuVerifier:
 
     def _run_committee(self, ct, messages, indices, signatures, device_hash: bool):
         n = len(messages)
-        up = _uploader()
         tl_on = timeline.enabled()
         tl_batch = timeline.TIMELINE.next_batch() if tl_on else 0
-        futs, oks, spans = [], [], []
-        for ci, lo in enumerate(range(0, n, self.chunk)):
-            hi = min(lo + self.chunk, n)
-            _M_CHUNKS.inc()
-            idx_chunk = indices[lo:hi]
+        pool = self.pipeline.pool
+        defer = self._defer_readback
+        tasks, oks = [], []
+
+        def make_task(ci: int, lo: int, hi: int) -> ChunkTask:
             tlkey = (tl_batch, ci, hi - lo) if tl_on else None
-            st_span = timeline.span_for("stage", tlkey)
-            with metrics.span(_M_STAGE), st_span:
-                if device_hash:
-                    staged = prepare_batch_committee_dh(
-                        messages[lo:hi], idx_chunk, signatures[lo:hi]
-                    )
-                else:
-                    staged = prepare_batch_committee(
-                        messages[lo:hi],
-                        [ct.keys[i] for i in idx_chunk],
-                        idx_chunk,
-                        signatures[lo:hi],
-                    )
-            width = self._bucket(hi - lo)
-            _M_PAD_LANES.inc(width - (hi - lo))
-            futs.append(
-                up.submit(
-                    self._upload_dispatch_committee,
-                    ct,
-                    _pad(staged["packed"], width),
-                    _pad(staged["idx"], width),
-                    device_hash,
-                    tlkey,
+            release: list = []
+
+            def stage():
+                _M_CHUNKS.inc()
+                idx_chunk = indices[lo:hi]
+                with metrics.span(_M_STAGE):
+                    if device_hash:
+                        staged = prepare_batch_committee_dh(
+                            messages[lo:hi], idx_chunk, signatures[lo:hi]
+                        )
+                    else:
+                        staged = prepare_batch_committee(
+                            messages[lo:hi],
+                            [ct.keys[i] for i in idx_chunk],
+                            idx_chunk,
+                            signatures[lo:hi],
+                        )
+                width = self._bucket(hi - lo)
+                _M_PAD_LANES.inc(width - (hi - lo))
+                oks.append((lo, hi, staged["s_ok"]))
+                if defer:
+                    # Deferred readback never blocks per chunk, so no
+                    # point marks a pooled buffer reusable — fresh
+                    # buffers, jax holds them through the async upload.
+                    return _pad(staged["packed"], width), _pad(staged["idx"], width)
+                packed = pool.pad(staged["packed"], width)
+                idx = pool.pad(staged["idx"], width)
+                release.extend((packed, idx))
+                return packed, idx
+
+            def submit(payload):
+                packed, idx = payload
+                # `ct` stays PINNED through the closure — a concurrent
+                # epoch re-registration cannot swap tables under this
+                # in-flight chunk (the §5.5c contract).
+                return self._upload_dispatch_committee(
+                    ct, packed, idx, device_hash, tlkey
                 )
+
+            def readback(handle):
+                if defer:
+                    return handle
+                with metrics.span(_M_READBACK):
+                    return self._materialize([handle])
+
+            return ChunkTask(
+                stage=stage, submit=submit, readback=readback, tlkey=tlkey,
+                release=release,
             )
-            oks.append(staged["s_ok"])
-            spans.append((lo, hi, width))
-        masks = [fu.result() for fu in futs]
+
+        for ci, lo in enumerate(range(0, n, self.chunk)):
+            tasks.append(make_task(ci, lo, min(lo + self.chunk, n)))
+        hosts = self.pipeline.run(tasks)
+        if defer:
+            hosts = self._materialize_deferred(hosts, n)
         out = np.empty(n, bool)
-        rb_span = timeline.span_for(
-            "readback", (tl_batch, len(spans) - 1, n) if tl_on else None
-        )
-        with metrics.span(_M_READBACK), rb_span:
-            full = self._materialize(masks)
-        off = 0
-        for (lo, hi, width), ok in zip(spans, oks):
-            out[lo:hi] = full[off : off + hi - lo] & ok
-            off += width
+        for (lo, hi, ok), host in zip(oks, hosts):
+            out[lo:hi] = host[: hi - lo] & ok
         return out
 
     def _upload_dispatch_committee(
@@ -1078,6 +1107,14 @@ class Ed25519TpuVerifier:
             return _verify_w4c96_jit(
                 ct.ta_ypx, ct.ta_ymx, ct.ta_xy2d, ct.valid, dev_i, dev_p
             )
+
+    def close(self) -> None:
+        """Drain the owned dispatch pipeline's worker threads. Safe to
+        call more than once; a closed verifier keeps working (every
+        subsequent batch runs the serial inline path). Un-closed
+        verifiers are reaped by GC/atexit — tests may construct and drop
+        verifiers freely without leaking threads."""
+        self.pipeline.close()
 
     def _bucket(self, n: int) -> int:
         b = self.min_bucket
@@ -1152,46 +1189,62 @@ class Ed25519TpuVerifier:
     def _run_packed(self, messages, keys, signatures, device_hash: bool):
         n = len(messages)
         fn = self._packed_dh_fn() if device_hash else self._packed_fn()
-        stage = prepare_batch_packed_dh if device_hash else prepare_batch_packed
-        up = _uploader()
+        stage_fn = prepare_batch_packed_dh if device_hash else prepare_batch_packed
         tl_on = timeline.enabled()
         tl_batch = timeline.TIMELINE.next_batch() if tl_on else 0
-        futs, oks, spans = [], [], []
-        for ci, lo in enumerate(range(0, n, self.chunk)):
-            hi = min(lo + self.chunk, n)
-            _M_CHUNKS.inc()
-            # The generic kernel decompresses every lane's key and rebuilds
-            # its -A window table on device — the per-batch cost the
-            # committee path amortizes away.
-            _M_TABLE_BUILDS.inc()
-            _M_DECOMPRESSIONS.inc(hi - lo)
+        pool = self.pipeline.pool
+        defer = self._defer_readback
+        tasks, oks = [], []
+
+        def make_task(ci: int, lo: int, hi: int) -> ChunkTask:
             tlkey = (tl_batch, ci, hi - lo) if tl_on else None
-            st_span = timeline.span_for("stage", tlkey)
-            with metrics.span(_M_STAGE), st_span:
-                staged = stage(
-                    messages[lo:hi], keys[lo:hi], signatures[lo:hi]
-                )
-            width = self._bucket(hi - lo)
-            _M_PAD_LANES.inc(width - (hi - lo))
-            futs.append(
-                up.submit(
-                    _upload_dispatch, fn, _pad(staged["packed"], width),
-                    self._put, tlkey,
-                )
+            release: list = []
+
+            def stage():
+                _M_CHUNKS.inc()
+                # The generic kernel decompresses every lane's key and
+                # rebuilds its -A window table on device — the per-batch
+                # cost the committee path amortizes away.
+                _M_TABLE_BUILDS.inc()
+                _M_DECOMPRESSIONS.inc(hi - lo)
+                with metrics.span(_M_STAGE):
+                    staged = stage_fn(
+                        messages[lo:hi], keys[lo:hi], signatures[lo:hi]
+                    )
+                width = self._bucket(hi - lo)
+                _M_PAD_LANES.inc(width - (hi - lo))
+                oks.append((lo, hi, staged["s_ok"]))
+                if defer:
+                    # Deferred readback never blocks per chunk, so no
+                    # point marks a pooled buffer reusable — fresh
+                    # buffers, jax holds them through the async upload.
+                    return _pad(staged["packed"], width)
+                packed = pool.pad(staged["packed"], width)
+                release.append(packed)
+                return packed
+
+            def submit(packed):
+                return _upload_dispatch(fn, packed, self._put, tlkey)
+
+            def readback(handle):
+                if defer:
+                    return handle
+                with metrics.span(_M_READBACK):
+                    return self._materialize([handle])
+
+            return ChunkTask(
+                stage=stage, submit=submit, readback=readback, tlkey=tlkey,
+                release=release,
             )
-            oks.append(staged["s_ok"])
-            spans.append((lo, hi, width))
-        masks = [f.result() for f in futs]
+
+        for ci, lo in enumerate(range(0, n, self.chunk)):
+            tasks.append(make_task(ci, lo, min(lo + self.chunk, n)))
+        hosts = self.pipeline.run(tasks)
+        if defer:
+            hosts = self._materialize_deferred(hosts, n)
         out = np.empty(n, bool)
-        rb_span = timeline.span_for(
-            "readback", (tl_batch, len(spans) - 1, n) if tl_on else None
-        )
-        with metrics.span(_M_READBACK), rb_span:
-            full = self._materialize(masks)
-        off = 0
-        for (lo, hi, width), ok in zip(spans, oks):
-            out[lo:hi] = full[off : off + hi - lo] & ok
-            off += width
+        for (lo, hi, ok), host in zip(oks, hosts):
+            out[lo:hi] = host[: hi - lo] & ok
         return out
 
     def _materialize(self, masks) -> np.ndarray:
@@ -1200,6 +1253,22 @@ class Ed25519TpuVerifier:
         if len(masks) == 1:
             return np.asarray(masks[0])
         return np.asarray(jnp.concatenate(masks))
+
+    def _materialize_deferred(self, handles: list, n: int) -> list:
+        """Deferred-readback tail (`_defer_readback`, multi-process
+        mesh): ONE `_materialize` over every chunk's device handle — a
+        single end-of-batch allgather, the pre-pipeline multihost shape
+        ('per-transfer latency is paid once, not per chunk') — split
+        back into per-chunk host arrays on the deterministic bucket
+        widths."""
+        with metrics.span(_M_READBACK):
+            full = self._materialize(handles)
+        out, off = [], 0
+        for lo in range(0, n, self.chunk):
+            width = self._bucket(min(lo + self.chunk, n) - lo)
+            out.append(full[off:off + width])
+            off += width
+        return out
 
     def _run_chunk(self, messages, keys, signatures) -> np.ndarray:
         n = len(messages)

@@ -209,10 +209,13 @@ class DeviceTimeline:
             {
                 "batches": len(batches),
                 "chunks": len(chunks),
+                # 6 decimals: bench.py --pipeline-ab compares serial vs
+                # pipelined occupancy STRICTLY, and on fast hosts the gap
+                # can live below 1e-4 (4-digit rounding would tie).
                 "span_s": round(span_s, 6),
-                "occupancy": round(busy_s / span_s, 4),
+                "occupancy": round(busy_s / span_s, 6),
                 "overlap_headroom": round(
-                    hideable / total_upload if total_upload > 0 else 0.0, 4
+                    hideable / total_upload if total_upload > 0 else 0.0, 6
                 ),
                 "phase_s": {p: round(s, 6) for p, s in phase_s.items()},
                 "idle": {
@@ -255,20 +258,37 @@ TIMELINE = DeviceTimeline()
 
 
 class _Span:
-    """Context manager recording one interval (monotonic enter/exit)."""
+    """Context manager recording one interval (monotonic enter/exit).
 
-    __slots__ = ("_tl", "_batch", "_chunk", "_phase", "_n", "_t0")
+    `start` backdates the interval's opening edge to a moment the caller
+    already observed (clamped to never sit in the future): the dispatch
+    pipeline opens each `readback` span at dispatch completion, because
+    the device has been computing since then even if the readback worker
+    dequeued the chunk late.
+    """
 
-    def __init__(self, tl: DeviceTimeline, phase: str, batch: int, chunk: int, n: int):
+    __slots__ = ("_tl", "_batch", "_chunk", "_phase", "_n", "_t0", "_start")
+
+    def __init__(
+        self,
+        tl: DeviceTimeline,
+        phase: str,
+        batch: int,
+        chunk: int,
+        n: int,
+        start: float | None = None,
+    ):
         self._tl = tl
         self._phase = phase
         self._batch = batch
         self._chunk = chunk
         self._n = n
         self._t0 = 0.0
+        self._start = start
 
     def __enter__(self) -> "_Span":
-        self._t0 = time.monotonic()
+        now = time.monotonic()
+        self._t0 = now if self._start is None else min(self._start, now)
         return self
 
     def __exit__(self, *exc) -> None:
@@ -291,23 +311,30 @@ NULL = _NullSpan()
 
 
 def span(
-    phase: str, batch: int, chunk: int, n: int = 0, timeline: DeviceTimeline | None = None
+    phase: str,
+    batch: int,
+    chunk: int,
+    n: int = 0,
+    timeline: DeviceTimeline | None = None,
+    start: float | None = None,
 ):
     """`with timeline.span("upload", b, c, n): ...` — no-op when disabled."""
     if not _enabled:
         return NULL
     # `is None`, not truthiness: an EMPTY DeviceTimeline is falsy (__len__).
-    return _Span(TIMELINE if timeline is None else timeline, phase, batch, chunk, n)
+    return _Span(
+        TIMELINE if timeline is None else timeline, phase, batch, chunk, n, start
+    )
 
 
-def span_for(phase: str, tlkey: tuple | None):
+def span_for(phase: str, tlkey: tuple | None, start: float | None = None):
     """`span` over the chunk loops' optional (batch, chunk, n) key:
     NULL when the key is None (their "timeline off" sentinel). One
     guard here instead of one per call site — and `is None`, so a
     future falsy key shape cannot silently disable recording."""
     if tlkey is None:
         return NULL
-    return span(phase, *tlkey)
+    return span(phase, *tlkey, start=start)
 
 
 def summary() -> dict:

@@ -222,10 +222,13 @@ def sharded_committee_fn(mesh: Mesh, dp_axis: str = "dp", device_hash: bool = Fa
 class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     """Drop-in Ed25519TpuVerifier that shards batches over a mesh.
 
-    Uses the packed (128 B/signature) wire format and the threaded upload
-    pipeline of the base class; chunks are device_put with an explicit
-    batch-axis NamedSharding so the transfer lands sharded (no device-0
-    staging + reshard). `packed=False` restores the f32-argument
+    Uses the packed (128 B/signature) wire format and the base class's
+    owned DispatchPipeline (ops/pipeline.py: bounded in-flight window,
+    pooled staging buffers, streamed per-chunk readback — single-process
+    meshes only; a multi-process mesh forces the serial depth=1 window,
+    see __init__); chunks are device_put with an explicit batch-axis
+    NamedSharding so the transfer lands sharded (no device-0 staging +
+    reshard). `packed=False` restores the f32-argument
     `sharded_verify_fn` path (used by the legacy bit-ladder kernel).
 
     The committee-resident path (`set_committee` /
@@ -245,6 +248,21 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
         self._multiprocess = any(
             d.process_index != me for d in np.asarray(self.mesh.devices).flat
         )
+        if self._multiprocess:
+            # Streamed per-chunk readback is an ALLGATHER on a multi-
+            # process mesh, and the pipeline's readback worker would race
+            # its collective launches against the upload worker's kernel
+            # launches — every process must issue collectives in one
+            # global order, so the deeper window is single-process-only.
+            # depth=1 keeps the launch order (dispatch k, dispatch k+1,
+            # ...) identical on every process, and DEFERRED readback
+            # restores the pre-pipeline multihost shape: every chunk's
+            # dispatch is queued async (compute still overlaps later
+            # chunks' staging), then ONE end-of-batch allgather
+            # materializes all masks — per-transfer latency is paid
+            # once, not per chunk, decisive over tunneled links.
+            self.pipeline.set_depth(1)
+            self._defer_readback = True
         # per-device shard keeps full lanes (and pallas BLOCK alignment)
         lane = 128
         if self.kernel == "pallas":
@@ -338,12 +356,18 @@ class ShardedEd25519Verifier(ed.Ed25519TpuVerifier):
     def _materialize(self, masks) -> np.ndarray:
         """Multi-host mesh: the mask is sharded across PROCESSES, so a
         plain np.asarray raises ('spans non-addressable devices'); gather
-        the global value first. Every process calls verify_batch_mask with
-        the same inputs (SPMD), so the allgather is collective-safe."""
+        the global value first. Every process calls verify_batch_mask
+        with the same inputs (SPMD) and the multi-process window runs
+        depth=1 with DEFERRED readback (__init__), so this allgather is
+        reached once per batch in the same order on every process —
+        collective-safe."""
         full = masks[0] if len(masks) == 1 else jnp.concatenate(masks)
         if self._multiprocess:
             from jax.experimental import multihost_utils
 
+            # Called ONCE per batch (`_defer_readback` batches every
+            # chunk handle into this single allgather); every process
+            # reaches it in the same SPMD order — collective-safe.
             return np.asarray(
                 multihost_utils.process_allgather(full, tiled=True)
             )

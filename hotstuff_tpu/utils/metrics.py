@@ -540,6 +540,17 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("scheduler.queue_sync_s", "histogram", None),
     ("scheduler.queue_ingress_s", "histogram", None),
     ("scheduler.queue_mempool_s", "histogram", None),
+    # ops/pipeline.py — double-buffered async dispatch pipeline (§5.5i).
+    # `pipeline.steals` is incremented by crypto/scheduler.py's cross-chip
+    # work-stealing bulk dispatch; the rest by DispatchPipeline itself.
+    ("pipeline.chunks", "counter", None),
+    ("pipeline.depth", "gauge", None),
+    ("pipeline.inflight", "gauge", None),
+    ("pipeline.stalls", "counter", None),
+    ("pipeline.stall_s", "histogram", None),
+    ("pipeline.buffer_reuse", "counter", None),
+    ("pipeline.buffer_allocs", "counter", None),
+    ("pipeline.steals", "counter", None),
     # consensus/core.py + aggregator.py + synchronizer.py
     ("consensus.proposals", "counter", None),
     ("consensus.votes", "counter", None),

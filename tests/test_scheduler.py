@@ -225,3 +225,106 @@ def test_lane_stats_percentiles():
     assert 45.0 <= s["p50_ms"] <= 55.0
     assert 95.0 <= s["p99_ms"] <= 99.0
     assert s["max_ms"] == 99.0
+
+
+# ---------------------------------------------------------------------------
+# Cross-chip work stealing (ISSUE 9): bulk buckets dispatch to whichever
+# backend has a free pipeline slot; the home backend keeps every critical
+# dispatch; inline (chaos) mode forces stealing off.
+
+
+class BlockingBackend(CryptoBackend):
+    """Home backend whose bulk verifications park on a gate — the 'device
+    busy' half of the steal scenario (two fake backends, no jax)."""
+
+    name = "blocking"
+
+    def __init__(self, gate):
+        self.calls: list[int] = []
+        self._gate = gate
+
+    def verify_batch_mask(self, messages, keys, signatures, **_kw):
+        self.calls.append(len(messages))
+        self._gate.wait(timeout=5)
+        return [True] * len(messages)
+
+
+def test_bulk_bucket_steals_to_free_sibling_backend(run_async):
+    """With the home backend's single bulk slot held by an in-flight
+    dispatch, the next bulk bucket ships to the sibling shard instead of
+    queueing behind it — and the steal is counted."""
+
+    async def body():
+        import threading
+
+        gate = threading.Event()
+        home = BlockingBackend(gate)
+        sibling = StubBackend()
+        svc = BatchVerificationService(
+            home,
+            use_scheduler=True,
+            scheduler_config=sched.SchedulerConfig(bulk_concurrency=1),
+            steal_backends=[sibling],
+        )
+        assert svc.scheduler.n_backends == 2
+        m1, p1 = _group(8, b"a")
+        f1 = asyncio.ensure_future(
+            svc.verify_group(m1, p1, source="mempool", dedup=False)
+        )
+        for _ in range(400):  # wait until home's dispatch is in flight
+            if home.calls:
+                break
+            await asyncio.sleep(0.005)
+        assert home.calls == [8]
+        m2, p2 = _group(4, b"b")
+        f2 = asyncio.ensure_future(
+            svc.verify_group(m2, p2, source="mempool", dedup=False)
+        )
+        # the second bucket must complete on the sibling while home is
+        # still parked on the gate
+        assert all(await asyncio.wait_for(f2, 5.0))
+        assert sibling.calls == [4], sibling.calls
+        assert home.calls == [8], home.calls
+        assert svc.scheduler.stats["steals"] == 1
+        assert svc.scheduler.summary()["backends"] == 2
+        gate.set()
+        assert all(await asyncio.wait_for(f1, 5.0))
+
+    run_async(body())
+
+
+def test_critical_never_steals_even_with_siblings(run_async):
+    """Consensus-critical dispatches always ride the home backend (the
+    committee-registered one), no matter how many siblings are free."""
+
+    async def body():
+        home = StubBackend()
+        sibling = StubBackend()
+        svc = BatchVerificationService(
+            home, use_scheduler=True, steal_backends=[sibling]
+        )
+        m, p = _group(3, b"q")
+        assert all(await svc.verify_group(m, p, source="consensus", dedup=False))
+        assert home.calls == [3]
+        assert sibling.calls == []
+        assert svc.scheduler.stats["steals"] == 0
+
+    run_async(body())
+
+
+def test_inline_chaos_mode_forces_stealing_off(run_async):
+    """inline=True (the chaos virtual-time mode) must stay bit-identical
+    per seed: which backend a bucket lands on cannot depend on thread
+    timing, so steal_backends is dropped and n_backends stays 1."""
+
+    async def body():
+        svc = BatchVerificationService(
+            StubBackend(), inline=True, steal_backends=[StubBackend()]
+        )
+        assert svc.scheduler.n_backends == 1
+        assert svc._steal_backends == []
+        m, p = _group(2)
+        assert all(await svc.verify_group(m, p, source="mempool", dedup=False))
+        assert svc.scheduler.stats["steals"] == 0
+
+    run_async(body())

@@ -154,6 +154,44 @@ def test_verifier_chunk_loop_records_intervals():
     assert 0.0 <= s["overlap_headroom"] <= 1.0
 
 
+def test_deferred_readback_masks_bit_identical():
+    """`_defer_readback` (the multi-process mesh mode, parallel/mesh.py):
+    per-chunk readbacks return raw device handles and ONE end-of-batch
+    `_materialize` call splits the concatenated mask back on bucket
+    widths. Masks must match the streamed per-chunk path bit-for-bit —
+    valid AND forged lanes. Single-chip here (multihost needs the
+    `cryptography` wheel this box lacks); the defer/concat/split
+    machinery is what's under test, at the same cache-shared w4/128
+    2-chunk shapes as the wiring test above."""
+    pytest.importorskip("jax")
+    from hotstuff_tpu.crypto import pysigner
+    from hotstuff_tpu.ops.ed25519 import Ed25519TpuVerifier
+
+    pool = []
+    for i in range(8):
+        pk, seed = pysigner.keypair_from_seed(bytes([i + 1]) * 32)
+        m = (b"defer-%d" % i).ljust(32, b"\0")
+        pool.append((m, pk, pysigner.sign(seed, m)))
+    msgs = [pool[i % 8][0] for i in range(128)]
+    pks = [pool[i % 8][1] for i in range(128)]
+    sigs = [pool[i % 8][2] for i in range(128)]
+    sigs[5] = os.urandom(64)  # forged lane in chunk 0
+    sigs[100] = os.urandom(64)  # forged lane in chunk 1
+
+    kw = dict(min_bucket=128, max_bucket=128, kernel="w4", chunk=64)
+    vn = Ed25519TpuVerifier(**kw)
+    vd = Ed25519TpuVerifier(**kw)
+    vd._defer_readback = True
+    try:
+        want = vn.verify_batch_mask(msgs, pks, sigs)
+        got = vd.verify_batch_mask(msgs, pks, sigs)
+    finally:
+        vn.close()
+        vd.close()
+    assert got.tolist() == want.tolist()
+    assert bool(want[0]) and not bool(want[5]) and not bool(want[100])
+
+
 def test_timeline_importable_without_jax():
     """The lint contract: ops.timeline (and the lazified ops package) must
     import on a host with no jax at all — DeviceScheduler's rule."""

@@ -149,3 +149,63 @@ def test_trace_disabled_run_stays_clean():
     assert report["ok"], report
     assert all(not evs for evs in report["flight_recorders"].values())
     assert report["watchdog_dumps"] == []
+
+
+def test_device_rows_get_own_slot_per_overlapping_chunk(tmp_path):
+    """Under the dispatch pipeline's in-flight window, chunk intervals
+    legitimately overlap (upload k+1 under dispatch k; readback k under
+    dispatch k+1). The Chrome render must give concurrent intervals their
+    own 'device sN' thread rows — overlapping X slices on one row nest
+    wrongly — and the markdown table must report the measured in-flight
+    depth instead of flagging the overlap."""
+    dump = {
+        "v": 1,
+        "kind": "device_timeline",
+        "node": "n0",
+        "anchor": {"mono": 0.0, "wall": 0.0},
+        "intervals": [
+            {"batch": 1, "chunk": 0, "phase": "upload", "t0": 0.0, "t1": 1.0, "n": 8},
+            {"batch": 1, "chunk": 0, "phase": "dispatch", "t0": 1.0, "t1": 3.0, "n": 8},
+            # chunk 1 upload overlaps chunk 0 dispatch (the double buffer)
+            {"batch": 1, "chunk": 1, "phase": "upload", "t0": 1.5, "t1": 2.5, "n": 8},
+            {"batch": 1, "chunk": 1, "phase": "dispatch", "t0": 3.0, "t1": 4.0, "n": 8},
+            # chunk 0 readback streams under chunk 1 dispatch
+            {"batch": 1, "chunk": 0, "phase": "readback", "t0": 3.2, "t1": 3.8, "n": 8},
+        ],
+        "summary": {
+            "batches": 1, "chunks": 2, "span_s": 4.0, "occupancy": 0.95,
+            "overlap_headroom": 0.5,
+            "phase_s": {"stage": 0.0, "upload": 2.0, "dispatch": 3.0,
+                        "readback": 0.6},
+            "idle": {"count": 0, "total_s": 0.0, "p50_s": 0.0, "max_s": 0.0},
+        },
+    }
+    path = tmp_path / "tl.json"
+    path.write_text(json.dumps(dump))
+    nodes = trace_report.load_inputs([str(path)])
+
+    chrome = trace_report.chrome_trace(nodes)
+    slices = [
+        e for e in chrome["traceEvents"]
+        if e.get("cat") == "device" and e["ph"] == "X"
+    ]
+    assert len(slices) == 5
+    # no two overlapping device slices share a thread row
+    by_tid: dict[int, list[tuple[float, float]]] = {}
+    for e in slices:
+        by_tid.setdefault(e["tid"], []).append((e["ts"], e["ts"] + e["dur"]))
+    for spans in by_tid.values():
+        spans.sort()
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert b0 >= a1 - 1e-6, (spans,)
+    assert len(by_tid) == 2  # the window never exceeded 2 in flight
+    names = {
+        e["args"]["name"]
+        for e in chrome["traceEvents"]
+        if e.get("name") == "thread_name" and e["tid"] >= 2
+    }
+    assert names == {"device s0", "device s1"}
+
+    table = trace_report.device_timeline_table(nodes)
+    assert "in-flight" in table
+    assert "| 2 |" in table  # measured depth, rendered not flagged

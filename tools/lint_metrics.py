@@ -39,8 +39,15 @@ The telemetry lint (utils/telemetry.py) fails rc 1 when:
     (`telemetry.default_slos()`) — its published slo_s would be back to
     an advisory string nothing judges.
 
-Both `utils/telemetry.py` and `ops/timeline.py` must stay importable
-without jax (like DeviceScheduler) — this lint runs on jax-less hosts.
+The pipeline lint (ops/pipeline.py) fails rc 1 when a DispatchPipeline
+timeline stage name (`pipeline.TIMELINE_STAGES`) is not one of
+DeviceTimeline's known phases (`timeline.PHASES`) — a renamed stage
+would silently fall out of the occupancy/headroom math and out of
+trace_report.py's device rows.
+
+`utils/telemetry.py`, `ops/timeline.py` and `ops/pipeline.py` must stay
+importable without jax (like DeviceScheduler) — this lint runs on
+jax-less hosts.
 
 Exit codes: 0 = clean, 1 = violations found, 2 = usage error.
 """
@@ -166,6 +173,23 @@ def lint_telemetry() -> list[str]:
     return problems
 
 
+def lint_pipeline() -> list[str]:
+    """Every DeviceTimeline stage a DispatchPipeline run can stamp must
+    be a known timeline phase: the occupancy/headroom summary and the
+    trace_report device rows key on the PHASES vocabulary, so an unknown
+    stage records intervals nothing ever reads."""
+    from hotstuff_tpu.ops import pipeline, timeline
+
+    return [
+        f"DispatchPipeline timeline stage {name!r} is not one of "
+        f"DeviceTimeline's phases {sorted(timeline.PHASES)} — it would "
+        "fall out of the occupancy/headroom math and the trace_report "
+        "device rows"
+        for name in pipeline.TIMELINE_STAGES
+        if name not in timeline.PHASES
+    ]
+
+
 def run(root: str) -> list[str]:
     from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
     from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
@@ -184,7 +208,7 @@ def run(root: str) -> list[str]:
                 EVENT_KINDS,
                 set(SOURCE_CLASSES),
             )
-    return problems + lint_scheduler() + lint_telemetry()
+    return problems + lint_scheduler() + lint_telemetry() + lint_pipeline()
 
 
 def main(argv: list[str] | None = None) -> int:

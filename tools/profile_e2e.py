@@ -67,6 +67,15 @@ def main() -> None:
         "committee path (replicated tables, 96 B + 4 B-index wire rows)",
     )
     ap.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="add serial-vs-pipelined A/B phase rows (ops/pipeline.py): "
+        "the same e2e workload through DispatchPipeline depth=1 then "
+        "depth=2, each with its own device occupancy / overlap headroom "
+        "/ stall line — the per-leg attribution behind "
+        "bench.py --pipeline-ab",
+    )
+    ap.add_argument(
         "--metrics-out",
         default=None,
         help="write the in-process metrics dump (utils/metrics.py) here — "
@@ -245,6 +254,39 @@ def main() -> None:
                 n,
             )
         )
+
+    # --- dispatch pipeline A/B ----------------------------------------------
+    # Serial (depth=1: stage/upload/dispatch/readback strictly in turn)
+    # against the double-buffered window (depth=2: staging and readback
+    # hidden under the neighbouring chunk's device phases). Each leg
+    # resets the global device timeline so its occupancy / headroom /
+    # stall numbers are its own.
+    if args.pipeline:
+        from hotstuff_tpu.ops import timeline as tl_mod
+
+        for depth, label in ((1, "serial"), (2, "pipelined")):
+            pv = ed.Ed25519TpuVerifier(
+                max_bucket=8192, kernel=args.kernel, chunk=c,
+                pipeline_depth=depth,
+            )
+            try:
+                pv.verify_batch_mask(msgs, pks, sigs)  # warm the widths
+                tl_mod.reset()
+                times = _t(
+                    lambda: pv.verify_batch_mask(msgs, pks, sigs), args.reps
+                )
+                leg = tl_mod.summary()
+                rows.append(
+                    _fmt(f"e2e ({label}, depth={depth})", times, n)
+                )
+                rows.append(
+                    f"{'  -> leg occupancy':<28} "
+                    f"{leg['occupancy'] * 100:>8.2f} %  "
+                    f"headroom {leg['overlap_headroom'] * 100:.1f} %  "
+                    f"stalls {pv.pipeline.stats['stalls']}"
+                )
+            finally:
+                pv.close()
 
     per_chunk = n // c
     print(f"# batch={n} chunk={c} chunks={per_chunk} kernel={args.kernel}")

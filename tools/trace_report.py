@@ -293,10 +293,21 @@ def device_timeline_table(nodes: list[dict]) -> str:
         if not s:
             continue
         idle = s.get("idle", {})
+        # Measured in-flight window: how many device-phase intervals ran
+        # concurrently (1 = serial dispatch; 2+ = the async pipeline's
+        # double buffering doing its job — expected, not an anomaly).
+        dev = [
+            iv for iv in rec.get("intervals", ())
+            if iv.get("phase") in ("upload", "dispatch", "readback")
+        ]
+        depth = 1 + max(
+            (si for _iv, si in _assign_device_slots(dev)), default=0
+        )
         rows.append(
             f"| {rec['node']} | {s.get('chunks', 0)} "
             f"| {s.get('occupancy', 0.0) * 100:.1f} "
             f"| {s.get('overlap_headroom', 0.0) * 100:.1f} "
+            f"| {depth} "
             f"| {idle.get('count', 0)} | {idle.get('p50_s', 0.0) * 1e3:.2f} "
             f"| {idle.get('max_s', 0.0) * 1e3:.2f} |"
         )
@@ -304,10 +315,31 @@ def device_timeline_table(nodes: list[dict]) -> str:
         return ""
     return (
         "### Device timeline (occupancy & host<->device gap attribution)\n\n"
-        "| node | chunks | occupancy % | overlap headroom % | idle gaps "
-        "| idle p50 (ms) | idle max (ms) |\n"
-        "|---|---|---|---|---|---|---|\n" + "\n".join(rows)
+        "| node | chunks | occupancy % | overlap headroom % | in-flight "
+        "| idle gaps | idle p50 (ms) | idle max (ms) |\n"
+        "|---|---|---|---|---|---|---|---|\n" + "\n".join(rows)
     )
+
+
+def _assign_device_slots(intervals: list[dict]) -> list[tuple[dict, int]]:
+    """Greedy interval coloring: each interval goes to the lowest slot
+    whose previous occupant has finished. A serial dispatch needs one
+    slot; a depth-k pipeline needs up to k+1 (the in-flight window plus
+    the overlapped staging) — the slot count renders the window, it does
+    not flag it."""
+    ordered = sorted(intervals, key=lambda iv: (iv["t0"], iv["t1"]))
+    slot_end: list[float] = []
+    out: list[tuple[dict, int]] = []
+    for iv in ordered:
+        for si, end in enumerate(slot_end):
+            if iv["t0"] >= end - 1e-12:
+                slot_end[si] = iv["t1"]
+                out.append((iv, si))
+                break
+        else:
+            slot_end.append(iv["t1"])
+            out.append((iv, len(slot_end) - 1))
+    return out
 
 
 def chrome_trace(nodes: list[dict]) -> dict:
@@ -346,20 +378,54 @@ def chrome_trace(nodes: list[dict]) -> dict:
                 "args": {"name": "ingress"},
             }
         )
-        # Device-timeline rows (ops/timeline.py): per-chunk upload/
-        # dispatch/readback slices on their own thread, so transfer vs
-        # compute overlap is visible beside the six-stage block rows.
+        # Device-timeline rows (ops/timeline.py): per-chunk stage/upload/
+        # dispatch/readback slices, so transfer vs compute overlap is
+        # visible beside the six-stage block rows. Under the dispatch
+        # pipeline's deeper in-flight window (ops/pipeline.py) chunk rows
+        # LEGITIMATELY overlap — chunk k+1's upload runs under chunk k's
+        # dispatch — and overlapping duration slices on one Chrome thread
+        # row nest incorrectly. Greedy slot assignment gives concurrent
+        # intervals their own "device sN" rows. Only the DEVICE phases
+        # (upload/dispatch/readback — the same set device_timeline_table
+        # and the occupancy union count) participate in slot assignment,
+        # so the device row count matches the table's in-flight depth;
+        # host-side `stage` packing renders on its own "host stage" row.
         if rec.get("intervals"):
-            events.append(
-                {
-                    "ph": "M",
-                    "name": "thread_name",
-                    "pid": pid,
-                    "tid": 2,
-                    "args": {"name": "device"},
-                }
-            )
-            for iv in rec["intervals"]:
+            dev_ivs = [
+                iv for iv in rec["intervals"]
+                if iv.get("phase") in ("upload", "dispatch", "readback")
+            ]
+            host_ivs = [
+                iv for iv in rec["intervals"]
+                if iv.get("phase") not in ("upload", "dispatch", "readback")
+            ]
+            assigned = _assign_device_slots(dev_ivs)
+            n_slots = 1 + max((s for _iv, s in assigned), default=0)
+            for si in range(n_slots):
+                events.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": pid,
+                        "tid": 2 + si,
+                        "args": {
+                            "name": "device" if n_slots == 1 else f"device s{si}"
+                        },
+                    }
+                )
+            if host_ivs:
+                events.append(
+                    {
+                        "ph": "M",
+                        "name": "thread_name",
+                        "pid": pid,
+                        "tid": 2 + n_slots,
+                        "args": {"name": "host stage"},
+                    }
+                )
+            for iv, si in [(iv, si) for iv, si in assigned] + [
+                (iv, n_slots) for iv in host_ivs
+            ]:
                 ts = (iv["t0"] + rec["offset"] - (base or 0.0)) * 1e6
                 events.append(
                     {
@@ -367,7 +433,7 @@ def chrome_trace(nodes: list[dict]) -> dict:
                         "cat": "device",
                         "ph": "X",
                         "pid": pid,
-                        "tid": 2,
+                        "tid": 2 + si,
                         "ts": ts,
                         "dur": max(0.0, (iv["t1"] - iv["t0"]) * 1e6),
                         "args": {"n": iv.get("n", 0), "phase": iv["phase"]},
