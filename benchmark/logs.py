@@ -151,6 +151,24 @@ def _parse_node(text: str) -> dict:
     # matters.
     out["slo_fired"] = _search_all(r"SLO burn fired: (\S+)", text)
     out["slo_cleared"] = _search_all(r"SLO burn cleared: (\S+)", text)
+    # Reconfiguration / catch-up lines (consensus/reconfig.py +
+    # synchronizer.py + core.py): epoch switches with their activation
+    # rounds, and range-sync start lag / fetched-block progress.
+    out["epoch_switches"] = [
+        (int(e), int(r))
+        for e, r in _search_all(
+            r"Epoch switch to (\d+) at activation round (\d+)", text
+        )
+    ]
+    out["range_lags"] = [
+        int(lag)
+        for lag in _search_all(
+            r"Range sync started for \S+: (\d+) rounds behind", text
+        )
+    ]
+    out["range_blocks"] = sum(
+        int(n) for n in _search_all(r"Range sync fetched (\d+) blocks", text)
+    )
     occ = _search_all(
         r"TELEMETRY device occupancy ([\d.]+)% overlap headroom ([\d.]+)%",
         text,
@@ -245,6 +263,11 @@ class LogParser:
         self.watchdog_dumps: list[str] = []  # recorder dump paths
         self.slo_fired: list[str] = []  # SLO burn alerts across nodes
         self.slo_cleared: list[str] = []
+        # (epoch, activation round) per switch line across nodes, and the
+        # per-range-sync start lags / fetched-block totals (catch-up).
+        self.epoch_switches: list[tuple[int, int]] = []
+        self.range_lags: list[int] = []
+        self.range_blocks = 0
         # (occupancy %, overlap headroom %) per node that logged telemetry
         self.occupancies: list[tuple[float, float]] = []
         # Final METRICS snapshot per node (utils/metrics.py), and the
@@ -271,6 +294,9 @@ class LogParser:
             self.watchdog_dumps.extend(r.get("watchdog_dumps", []))
             self.slo_fired.extend(r.get("slo_fired", []))
             self.slo_cleared.extend(r.get("slo_cleared", []))
+            self.epoch_switches.extend(r.get("epoch_switches", []))
+            self.range_lags.extend(r.get("range_lags", []))
+            self.range_blocks += r.get("range_blocks", 0)
             if r.get("occupancy") is not None:
                 self.occupancies.append(r["occupancy"])
             if r.get("metrics") is not None:
@@ -472,6 +498,21 @@ class LogParser:
                     f" SLO burn alerts: {len(self.slo_fired)} fired"
                     f" ({names}), {len(self.slo_cleared)} cleared\n"
                 )
+        reconfig = ""
+        if self.epoch_switches or self.range_lags:
+            reconfig = " + RECONFIG:\n"
+            if self.epoch_switches:
+                top_epoch, top_round = max(self.epoch_switches)
+                reconfig += (
+                    f" Epoch switches observed: {len(self.epoch_switches)}"
+                    f" (highest epoch {top_epoch} at round {top_round})\n"
+                )
+            if self.range_lags:
+                reconfig += (
+                    f" Catch-up: {len(self.range_lags)} range sync(s),"
+                    f" worst start lag {max(self.range_lags)} rounds,"
+                    f" {self.range_blocks} blocks fetched\n"
+                )
         warn = ""
         if self.misses:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"
@@ -510,6 +551,7 @@ class LogParser:
             )
             + ingress
             + telemetry
+            + reconfig
             + mtr
             + "-----------------------------------------\n"
         )

@@ -23,8 +23,8 @@ from .byzantine import (
     VoteWithholder,
 )
 from .invariants import LivenessChecker, SafetyChecker
-from .orchestrator import ChaosOrchestrator, DeterministicMempool
-from .plan import CrashWindow, FaultPlan, LinkFaults, Partition, SeededRng
+from .orchestrator import ChaosOrchestrator, DeterministicMempool, ReconfigDirective
+from .plan import CrashWindow, DelayedBoot, FaultPlan, LinkFaults, Partition, SeededRng
 from .scenarios import SCENARIOS, SHORT_SCENARIOS, run_scenario
 from .transport import FaultyTransport, NODE_LABEL
 from .vtime import VirtualTimeLoop
@@ -33,6 +33,7 @@ __all__ = [
     "AdversaryPolicy",
     "ChaosOrchestrator",
     "CrashWindow",
+    "DelayedBoot",
     "DeterministicMempool",
     "Equivocator",
     "FaultPlan",
@@ -41,6 +42,7 @@ __all__ = [
     "LivenessChecker",
     "NODE_LABEL",
     "Partition",
+    "ReconfigDirective",
     "SCENARIOS",
     "SHORT_SCENARIOS",
     "SafetyChecker",

@@ -15,6 +15,13 @@ Safety (2-chain HotStuff, consensus/src/messages.rs quorum rules):
   * certificates — every committed block's embedded QC re-verifies against
                   the pure-python RFC 8032 verifier with quorum stake:
                   zero false accepts can survive into a committed QC.
+  * epochs      — the checker maintains its OWN committee schedule from
+                  the committed chain (re-verifying each EpochChange's
+                  authority + signature independently), and judges every
+                  committed QC against the committee of the QC's round's
+                  epoch — on BOTH sides of a reconfiguration boundary. A
+                  certificate quorate under the wrong epoch's committee
+                  is a violation even if every signature is genuine.
 
 Liveness: commit height advances after a declared heal point (partitions
 healed, crashed nodes restarted) — evaluated per honest node.
@@ -22,6 +29,7 @@ healed, crashed nodes restarted) — evaluated per honest node.
 
 from __future__ import annotations
 
+from ..consensus.reconfig import EpochSchedule
 from ..crypto import pysigner
 from ..utils import metrics
 
@@ -32,6 +40,9 @@ _M_VIOLATIONS = metrics.counter("chaos.invariant_violations")
 class SafetyChecker:
     def __init__(self, committee) -> None:
         self.committee = committee
+        # Independent epoch view derived from the committed chain itself —
+        # never from any node's EpochManager state.
+        self.schedule = EpochSchedule(committee)
         self.violations: list[str] = []
         self._by_round: dict[int, tuple[bytes, int]] = {}  # round -> (digest, node)
         self._last: dict[int, object] = {}  # node -> last committed block
@@ -77,11 +88,16 @@ class SafetyChecker:
                 )
         self._last[node] = block
         self._check_certificate(node, block)
+        if getattr(block, "reconfig", None) is not None:
+            self._check_reconfig(node, block)
 
     def _check_certificate(self, node: int, block) -> None:
         """Re-verify the committed block's embedded QC with the independent
-        exact-integer verifier: quorum stake AND every signature. A forged
-        vote that slipped into an assembled QC is caught here."""
+        exact-integer verifier: quorum stake AND every signature, judged
+        against the committee of the QC's OWN epoch (the checker's
+        self-derived schedule). A forged vote that slipped into an
+        assembled QC — or a quorum counted under the wrong epoch's
+        committee — is caught here."""
         qc = block.qc
         if qc.is_genesis():
             return
@@ -90,10 +106,14 @@ class SafetyChecker:
             return
         self._verified_qcs.add(key)
         _M_CHECKS.inc()
+        committee = self.schedule.committee_for_round(qc.round)
         try:
-            qc.check_quorum(self.committee)
+            qc.check_quorum(committee)
         except Exception as e:
-            self._violate(f"committed QC fails quorum check at node {node}: {e}")
+            self._violate(
+                f"committed QC fails quorum check against epoch "
+                f"{committee.epoch} at node {node}: {e}"
+            )
             return
         msg = qc.signed_digest().data
         for pk, sig in qc.votes:
@@ -102,6 +122,42 @@ class SafetyChecker:
                     f"FALSE ACCEPT: committed QC (round {qc.round}) carries "
                     f"an invalid signature by {pk.short()}"
                 )
+
+    def _check_reconfig(self, node: int, block) -> None:
+        """A committed EpochChange re-verifies independently (author holds
+        stake in the CARRYING round's epoch, genuine signature, boundary
+        past the carrying block) and then extends the checker's own
+        schedule — the mapping later certificates are judged by."""
+        change = block.reconfig
+        _M_CHECKS.inc()
+        committee = self.schedule.committee_for_round(block.round)
+        if committee.stake(change.author) <= 0:
+            self._violate(
+                f"committed EpochChange (node {node}) signed by "
+                f"{change.author.short()}, not an epoch-{committee.epoch} "
+                "authority"
+            )
+            return
+        if not pysigner.verify(
+            change.author.data, change.digest().data, change.signature.data
+        ):
+            self._violate(
+                f"FALSE ACCEPT: committed EpochChange (node {node}) carries "
+                f"an invalid signature by {change.author.short()}"
+            )
+            return
+        if change.activation_round <= block.round:
+            self._violate(
+                f"committed EpochChange activates at round "
+                f"{change.activation_round}, not past its carrying block "
+                f"B{block.round}"
+            )
+            return
+        # Boundary = the DECLARED activation round, exactly as every
+        # node's EpochManager schedules it (pure chain content — see
+        # reconfig.EpochManager.apply for why no commit-position input
+        # is folded in). Idempotent per epoch.
+        self.schedule.apply(change.activation_round, change.committee())
 
     def ok(self) -> bool:
         return not self.violations

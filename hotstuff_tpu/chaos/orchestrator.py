@@ -36,6 +36,7 @@ from ..consensus.mempool_driver import (
     MempoolVerify,
     PayloadStatus,
 )
+from ..consensus.reconfig import EpochChange, EpochManager
 from ..crypto import pysigner
 from ..crypto.backend import set_backend
 from ..crypto.batch_service import BatchVerificationService
@@ -47,14 +48,32 @@ from ..utils import metrics, telemetry, tracing
 from ..utils.actors import SpawnScope, channel, spawn
 from .invariants import LivenessChecker, SafetyChecker
 from .plan import FaultPlan, SeededRng
-from .transport import NODE_LABEL, FaultyTransport, port_map
+from .transport import NODE_LABEL, FaultyTransport
 
 log = logging.getLogger("hotstuff.chaos")
 
 _M_CRASHES = metrics.counter("chaos.crashes")
 _M_RESTARTS = metrics.counter("chaos.restarts")
+_M_LATE_BOOTS = metrics.counter("chaos.late_boots")
 
 BASE_PORT = 25_000  # virtual — the transport keys on port, nothing binds
+
+
+@dataclass(slots=True)
+class ReconfigDirective:
+    """Declarative epoch-reconfiguration for chaos scenarios: at virtual
+    time `at`, the orchestrator builds a signed EpochChange — successor
+    committee = genesis members minus `remove` plus `add` (node indices)
+    — activating `activation_margin` rounds past the currently committed
+    tip, and queues it on every running committee node's core; whichever
+    leads next carries it through the chain (the epoch-commit rule does
+    the rest). `proposer` indexes the authority whose key signs it."""
+
+    at: float
+    add: tuple[int, ...] = ()
+    remove: tuple[int, ...] = ()
+    activation_margin: int = 10
+    proposer: int = 0
 
 
 @dataclass(slots=True)
@@ -109,7 +128,7 @@ class DeterministicMempool:
 class _NodeHandle:
     __slots__ = (
         "index", "pk", "seed", "store_path", "scope", "store", "service",
-        "policy", "running",
+        "policy", "running", "core", "epochs",
     )
 
     def __init__(self, index: int, pk: PublicKey, seed: bytes, store_path: str | None):
@@ -122,6 +141,8 @@ class _NodeHandle:
         self.service: BatchVerificationService | None = None
         self.policy = None
         self.running = False
+        self.core = None  # consensus Core (reconfig directives target it)
+        self.epochs: EpochManager | None = None  # this incarnation's view
 
 
 class ChaosOrchestrator:
@@ -137,6 +158,8 @@ class ChaosOrchestrator:
         flood: BulkFlood | None = None,
         scheduler_config: SchedulerConfig | None = None,
         telemetry_config: "telemetry.TelemetryConfig | None" = None,
+        committee_indices: list[int] | None = None,
+        reconfig: ReconfigDirective | None = None,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -155,18 +178,31 @@ class ChaosOrchestrator:
         # Node index = sorted-key order, matching LeaderElector rotation.
         pairs.sort(key=lambda kp: kp[0])
         self.keys = [(PublicKey(pk), seed_) for pk, seed_ in pairs]
+        # The GENESIS committee may cover only a subset of the booted
+        # nodes (committee_indices): a node outside it is a candidate
+        # validator, running the full stack but receiving nothing until a
+        # committed EpochChange admits it (the join scenario).
+        self.committee_indices = (
+            list(committee_indices) if committee_indices is not None else list(range(n))
+        )
         self.committee = Committee.new(
             [
-                (pk, 1, ("127.0.0.1", BASE_PORT + i))
-                for i, (pk, _) in enumerate(self.keys)
+                (self.keys[i][0], 1, ("127.0.0.1", BASE_PORT + i))
+                for i in self.committee_indices
             ]
         )
+        self.reconfig = reconfig
         self._own_store_dir = store_dir is None and bool(self.plan.crashes)
         if self._own_store_dir:
             store_dir = tempfile.mkdtemp(prefix="chaos-store-")
         self.store_dir = store_dir
 
-        self.transport = FaultyTransport(self.plan, self.rng, port_map(self.committee))
+        # Port routing covers EVERY booted node, committee member or not
+        # (a map derived from the genesis committee would leave a joining
+        # node's port unrouted and its catch-up traffic undeliverable).
+        self.transport = FaultyTransport(
+            self.plan, self.rng, {BASE_PORT + i: i for i in range(n)}
+        )
         self.safety = SafetyChecker(self.committee)
         self.liveness = LivenessChecker()
         self.honest = [i for i in range(n) if i not in self.byzantine]
@@ -183,6 +219,10 @@ class ChaosOrchestrator:
         self.telemetry_config = telemetry_config
         self.telemetry_planes: dict[int, telemetry.TelemetryPlane] = {}
         self.events: list[dict] = []
+        # Per-node epoch switches (EpochManager on_switch hook) — the
+        # report section the reconfig expectations judge.
+        self.epoch_events: dict[int, list[dict]] = {}
+        self._deferred_boots = {b.node for b in self.plan.boots}
         self.nodes = [
             _NodeHandle(
                 i,
@@ -194,6 +234,24 @@ class ChaosOrchestrator:
         ]
 
     # -- node lifecycle ------------------------------------------------------
+
+    def _on_epoch_switch(self, i: int):
+        def hook(committee: Committee, activation_round: int) -> None:
+            t = round(asyncio.get_running_loop().time(), 6)
+            entry = {
+                "t": t,
+                "epoch": committee.epoch,
+                "activation_round": activation_round,
+                "committee_size": committee.size(),
+            }
+            self.epoch_events.setdefault(i, []).append(entry)
+            self.events.append(
+                {"t": t, "event": "epoch_switch", "node": i, **{
+                    k: entry[k] for k in ("epoch", "activation_round")
+                }}
+            )
+
+        return hook
 
     def _boot(self, i: int) -> None:
         node = self.nodes[i]
@@ -214,8 +272,16 @@ class ChaosOrchestrator:
                 node.service = BatchVerificationService(
                     inline=True, scheduler_config=self.scheduler_config
                 )
+                # Per-incarnation epoch view: a restart rebuilds committed
+                # boundaries from the persisted store (Core.run loads it).
+                # register_backend stays on — the PurePythonBackend has no
+                # committee tables, so the hook is a no-op here while the
+                # switch events still record per node.
+                node.epochs = EpochManager(
+                    self.committee, on_switch=self._on_epoch_switch(i)
+                )
                 commit_channel = channel()
-                Consensus.run(
+                node.core = Consensus.run(
                     node.pk,
                     self.committee,
                     self.parameters,
@@ -224,6 +290,8 @@ class ChaosOrchestrator:
                     mempool.channel,
                     commit_channel,
                     verification_service=node.service,
+                    epoch_manager=node.epochs,
+                    listen_address=("127.0.0.1", BASE_PORT + i),
                 )
                 spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
         finally:
@@ -404,8 +472,23 @@ class ChaosOrchestrator:
         log.info("chaos: restarting node %d against %s", i, node.store_path)
         self._boot(i)
 
+    async def boot_late(self, i: int) -> None:
+        """First-time boot of a plan.boots node: empty store, live chain —
+        the genesis catch-up shape."""
+        node = self.nodes[i]
+        if node.running:
+            return
+        _M_LATE_BOOTS.inc()
+        self.events.append(
+            {"t": round(asyncio.get_running_loop().time(), 6), "event": "boot", "node": i}
+        )
+        tracing.RECORDER.record("chaos.restart", None, None, None, label=i)
+        log.info("chaos: late-booting node %d with an empty store", i)
+        self._boot(i)
+
     async def _lifecycle(self) -> None:
-        """Execute the plan's crash/restart windows on the virtual clock."""
+        """Execute the plan's crash/restart/boot windows on the virtual
+        clock."""
         loop = asyncio.get_running_loop()
         start = loop.time()
         steps: list[tuple[float, str, int]] = []
@@ -413,14 +496,70 @@ class ChaosOrchestrator:
             steps.append((w.at, "crash", w.node))
             if w.restart is not None:
                 steps.append((w.restart, "restart", w.node))
+        for b in self.plan.boots:
+            steps.append((b.at, "boot", b.node))
         for at, action, who in sorted(steps):
             delay = start + at - loop.time()
             if delay > 0:
                 await asyncio.sleep(delay)
             if action == "crash":
                 await self.crash(who)
+            elif action == "boot":
+                await self.boot_late(who)
             else:
                 await self.restart(who)
+
+    async def _drive_reconfig(self) -> None:
+        """Execute a ReconfigDirective: build the signed EpochChange from
+        the genesis committee ± the directive's node sets, activating
+        `activation_margin` rounds past the committed tip, and queue it on
+        every running committee node (whoever leads next proposes it).
+        Deterministic under the virtual clock: the committed tip at a
+        virtual instant is a pure function of the seed."""
+        d = self.reconfig
+        if d.at > 0:
+            await asyncio.sleep(d.at)
+        genesis = self.committee
+        members = []
+        for i, (pk, _seed) in enumerate(self.keys):
+            if i in d.remove:
+                continue
+            if pk in genesis.authorities or i in d.add:
+                members.append(
+                    (pk, genesis.stake(pk) or 1, ("127.0.0.1", BASE_PORT + i))
+                )
+        tip = max(
+            (
+                r
+                for commits in self.safety.commits.values()
+                for r, _digest in commits
+            ),
+            default=0,
+        )
+        author, seed = self.keys[d.proposer]
+        change = EpochChange.new_from_seed(
+            genesis.epoch + 1,
+            tip + d.activation_margin,
+            members,
+            author,
+            seed,
+        )
+        self.events.append(
+            {
+                "t": round(asyncio.get_running_loop().time(), 6),
+                "event": "reconfig_directive",
+                "epoch": change.new_epoch,
+                "activation_round": change.activation_round,
+            }
+        )
+        log.info("chaos: injecting %s", change)
+        for node in self.nodes:
+            if (
+                node.running
+                and node.core is not None
+                and node.pk in genesis.authorities
+            ):
+                node.core.schedule_reconfig(change)
 
     # -- run -----------------------------------------------------------------
 
@@ -488,15 +627,18 @@ class ChaosOrchestrator:
         try:
             with run_scope:
                 for i in range(self.n):
-                    self._boot(i)
+                    if i not in self._deferred_boots:
+                        self._boot(i)
                 if self.ingress is not None:
                     self._boot_ingress()
                 if self.flood is not None:
                     self._boot_flood()
                 if self.telemetry_config is not None:
                     self._boot_telemetry(loop)
-                if self.plan.crashes:
+                if self.plan.crashes or self.plan.boots:
                     spawn(self._lifecycle(), name="chaos-lifecycle")
+                if self.reconfig is not None:
+                    spawn(self._drive_reconfig(), name="chaos-reconfig")
                 deadline = start + duration
                 while loop.time() < deadline:
                     if self._target_met(min_commits, heal_t, start):
@@ -580,6 +722,18 @@ class ChaosOrchestrator:
                 str(i): node.service.scheduler.summary()
                 for i, node in enumerate(self.nodes)
                 if node.service is not None and node.service.scheduler is not None
+            },
+            # Per-node epoch switches (EpochManager on_switch): every
+            # node's observed boundary, with the activation round the
+            # reconfig expectations require to be unanimous.
+            "epoch_switches": {
+                str(i): list(events)
+                for i, events in sorted(self.epoch_events.items())
+            },
+            "final_epochs": {
+                str(i): node.epochs.applied_epoch
+                for i, node in enumerate(self.nodes)
+                if node.epochs is not None
             },
             "fault_trace": self.transport.trace,
             "fault_trace_overflow": self.transport.trace_overflow,

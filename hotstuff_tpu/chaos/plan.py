@@ -86,6 +86,17 @@ class CrashWindow:
     restart: float | None = None
 
 
+@dataclass(frozen=True)
+class DelayedBoot:
+    """Node `node` does not boot with the run: it starts for the FIRST
+    time at virtual time `at`, with an empty store — the genesis-catch-up
+    shape (a fresh validator joining a chain already in flight), as
+    opposed to CrashWindow's restart against persisted state."""
+
+    node: int
+    at: float
+
+
 @dataclass
 class FaultPlan:
     """The full schedule. `links` overrides `default_link` per directed
@@ -95,6 +106,7 @@ class FaultPlan:
     links: dict[tuple[int, int], LinkFaults] = field(default_factory=dict)
     partitions: list[Partition] = field(default_factory=list)
     crashes: list[CrashWindow] = field(default_factory=list)
+    boots: list[DelayedBoot] = field(default_factory=list)
 
     def link(self, src: int, dst: int) -> LinkFaults:
         return self.links.get((src, dst), self.default_link)
@@ -116,4 +128,5 @@ class FaultPlan:
                 {"node": c.node, "at": c.at, "restart": c.restart}
                 for c in self.crashes
             ],
+            "boots": [{"node": b.node, "at": b.at} for b in self.boots],
         }

@@ -23,8 +23,8 @@ from ..utils import metrics
 from ..utils.telemetry import TelemetryConfig
 from . import vtime
 from .byzantine import Equivocator, SigForger, StaleReplayer, VoteWithholder
-from .orchestrator import BulkFlood, ChaosOrchestrator
-from .plan import CrashWindow, FaultPlan, LinkFaults, Partition
+from .orchestrator import BulkFlood, ChaosOrchestrator, ReconfigDirective
+from .plan import CrashWindow, DelayedBoot, FaultPlan, LinkFaults, Partition
 
 # Bounds on one scenario run. VIRTUAL_TIMEOUT_S catches a stop condition
 # that never fires (virtual time races ahead forever); WALL_TIMEOUT_S is a
@@ -72,6 +72,13 @@ class Scenario:
     # per-node snapshot ring + SLO burn evaluator on the virtual clock,
     # embedded in the report's `telemetry` section.
     telemetry: Callable[[], TelemetryConfig] | None = None
+    # Genesis committee as node indices (None = every node): nodes outside
+    # it run the full stack as JOIN candidates, admitted only by a
+    # committed EpochChange (consensus/reconfig.py).
+    committee: tuple[int, ...] | None = None
+    # Epoch-reconfiguration directive (orchestrator.ReconfigDirective
+    # factory): a signed committee change injected mid-run.
+    reconfig: Callable[[], ReconfigDirective] | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -105,6 +112,11 @@ _register(
         description="No faults: 4 honest nodes on healthy 10 ms links must "
         "commit one common chain (the chaos plane's own sanity check).",
         plan=lambda: FaultPlan(default_link=_LINK),
+        # The scenario-registry lint requires every scenario to assert
+        # something beyond not-crashing: the baseline pins real traffic
+        # and the per-node commit floor (4 nodes x min_commits).
+        expect=lambda report, deltas: _expect_counter(deltas, "chaos.frames")
+        + _expect_counter(deltas, "consensus.commits", minimum=16),
     )
 )
 
@@ -557,6 +569,180 @@ _register(
         duration=240.0,
         min_commits=5,
         slow=True,
+        expect=lambda report, deltas: _expect_counter(deltas, "chaos.drops")
+        + _expect_counter(deltas, "consensus.sync_requests"),
+    )
+)
+
+# ---------------------------------------------------------------------------
+# Reconfiguration + catch-up scenarios (ROADMAP item 5, ISSUE 10). All three
+# use 150 ms links: realistic round pacing bounds the pure-python signature
+# work per virtual second (flash_crowd rationale), and a catch-up node's
+# chain replay is the dominant wall cost.
+
+_CATCHUP_LINK = LinkFaults(delay=0.15)
+
+# The acceptance bound: a catch-up node must end within this many committed
+# rounds of the live tip (commits lag the tip uniformly across nodes, so
+# committed-round lag measures tip lag without racing in-flight messages).
+MAX_TIP_LAG_ROUNDS = 4
+
+
+def _max_commit_round(report: dict, node: int) -> int:
+    return max(
+        (r for r, _d in report["commits"].get(str(node), [])), default=0
+    )
+
+
+def _tip_round(report: dict) -> int:
+    return max(
+        (
+            r
+            for commits in report["commits"].values()
+            for r, _d in commits
+        ),
+        default=0,
+    )
+
+
+def _expect_catchup(report: dict, deltas: dict, node: int) -> list[str]:
+    """Shared catch-up assertions: the node range-synced (not one digest
+    at a time) and ended within MAX_TIP_LAG_ROUNDS of the live tip."""
+    problems = _expect_counter(deltas, "sync.range_requests")
+    problems += _expect_counter(deltas, "sync.range_replies")
+    # Rounds outnumber blocks: the absent node's leader rounds fall to
+    # TCs, so a "9 rounds behind" gap may be only ~4 blocks of ancestry.
+    problems += _expect_counter(deltas, "sync.range_blocks", minimum=3)
+    if not report["commits"].get(str(node)):
+        problems.append(f"catch-up node {node} never committed")
+        return problems
+    tip = _tip_round(report)
+    mine = _max_commit_round(report, node)
+    if tip - mine > MAX_TIP_LAG_ROUNDS:
+        problems.append(
+            f"catch-up node {node} ended {tip - mine} rounds behind the "
+            f"tip (round {mine} vs {tip}; bound {MAX_TIP_LAG_ROUNDS})"
+        )
+    return problems
+
+
+def _expect_epoch_reconfig(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "reconfig.epoch_switches", minimum=4)
+    problems += _expect_counter(deltas, "reconfig.proposed")
+    switches = report.get("epoch_switches", {})
+    if not switches:
+        return problems + ["no node recorded an epoch switch"]
+    acts = {e["activation_round"] for evs in switches.values() for e in evs}
+    epochs_seen = {e["epoch"] for evs in switches.values() for e in evs}
+    if len(acts) != 1:
+        problems.append(f"nodes disagree on the activation round: {sorted(acts)}")
+        return problems
+    if epochs_seen != {2}:
+        problems.append(f"expected exactly epoch 2, saw {sorted(epochs_seen)}")
+    act = next(iter(acts))
+    # The original quorum members (0-2) must have switched...
+    for i in (0, 1, 2):
+        if str(i) not in switches:
+            problems.append(f"node {i} never applied the epoch switch")
+    # ...and committed on BOTH sides of the boundary: the safety checker
+    # verified those QCs against epoch 1 and epoch 2 committees
+    # respectively (run_scenario already folds its violations into ok).
+    for i in (0, 1, 2):
+        rounds = [r for r, _d in report["commits"].get(str(i), [])]
+        if not any(r < act for r in rounds):
+            problems.append(f"node {i} has no pre-boundary commit")
+        if not any(r > act for r in rounds):
+            problems.append(f"node {i} has no post-boundary commit")
+    # The JOINED validator caught up from genesis (range sync) and
+    # commits past the boundary...
+    problems += _expect_catchup(report, deltas, node=4)
+    if _max_commit_round(report, 4) <= act:
+        problems.append(
+            "joined node 4 never committed past the activation boundary"
+        )
+    # ...while the DEPARTED one stops at it (the new committee neither
+    # serves it blocks nor counts its votes; +2 covers in-flight frames).
+    left_max = _max_commit_round(report, 3)
+    if left_max > act + 2:
+        problems.append(
+            f"departed node 3 kept committing past the boundary "
+            f"(round {left_max} > activation {act})"
+        )
+    problems += _expect_counter(deltas, "chaos.invariant_checks")
+    return problems
+
+
+def _expect_genesis_catchup(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_catchup(report, deltas, node=3)
+    boots = [e for e in report["events"] if e["event"] == "boot"]
+    if [e["node"] for e in boots] != [3]:
+        problems.append(f"expected one late boot of node 3, saw {boots}")
+    return problems
+
+
+def _expect_long_offline(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "chaos.crashes")
+    problems += _expect_counter(deltas, "chaos.restarts")
+    problems += _expect_catchup(report, deltas, node=2)
+    return problems
+
+
+_register(
+    Scenario(
+        name="epoch_reconfig",
+        description="Validator join+leave at a committed epoch boundary "
+        "under load: a signed EpochChange rides the chain (epoch-commit "
+        "rule), nodes 0-3 hand the committee to {0,1,2,4} at the "
+        "activation round, the joining node 4 range-syncs from genesis "
+        "and commits past the boundary, the departing node 3 stops at "
+        "it, and every committed QC re-verifies against the committee of "
+        "its own epoch on both sides.",
+        n=5,
+        committee=(0, 1, 2, 3),
+        plan=lambda: FaultPlan(default_link=_CATCHUP_LINK),
+        reconfig=lambda: ReconfigDirective(
+            at=2.0, add=(4,), remove=(3,), activation_margin=10
+        ),
+        duration=12.0,
+        min_commits=0,  # no early stop: the boundary must play out
+        expect=_expect_epoch_reconfig,
+    )
+)
+
+_register(
+    Scenario(
+        name="genesis_catchup",
+        description="A committee validator boots for the first time at "
+        "t=6 with an EMPTY store while the chain runs: batched range "
+        "sync fetches and fully re-verifies the ancestor chain from "
+        "genesis, and the node ends within 4 committed rounds of the "
+        "live tip.",
+        plan=lambda: FaultPlan(
+            default_link=_CATCHUP_LINK,
+            boots=[DelayedBoot(node=3, at=6.0)],
+        ),
+        duration=11.0,
+        min_commits=0,  # no early stop: the catch-up window must play out
+        expect=_expect_genesis_catchup,
+    )
+)
+
+_register(
+    Scenario(
+        name="long_offline_catchup",
+        description="Node 2 crashes at t=1 and stays down for most of the "
+        "run; on restart against its persisted store it is dozens of "
+        "rounds behind and must range-sync to the tip (per-digest sync "
+        "would crawl at one block per retry), ending within 4 committed "
+        "rounds of the live tip with the double-vote guard intact.",
+        plan=lambda: FaultPlan(
+            default_link=_CATCHUP_LINK,
+            crashes=[CrashWindow(node=2, at=1.0, restart=9.0)],
+        ),
+        duration=12.0,
+        min_commits=0,  # no early stop: the offline window must play out
+        heal_t=9.0,
+        expect=_expect_long_offline,
     )
 )
 
@@ -565,7 +751,7 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
-    "telemetry.",
+    "telemetry.", "sync.", "reconfig.",
 )
 
 
@@ -595,6 +781,10 @@ def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
             flood=scenario.flood() if scenario.flood else None,
             scheduler_config=scenario.scheduler() if scenario.scheduler else None,
             telemetry_config=scenario.telemetry() if scenario.telemetry else None,
+            committee_indices=(
+                list(scenario.committee) if scenario.committee is not None else None
+            ),
+            reconfig=scenario.reconfig() if scenario.reconfig else None,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

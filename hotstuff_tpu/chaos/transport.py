@@ -248,19 +248,3 @@ class FaultyTransport:
         for k, v in extra.items():
             entry[k] = round(v, 6) if isinstance(v, float) else v
         self.trace.append(entry)
-
-
-def port_map(*committees) -> dict[int, int]:
-    """Build node_of_port from committee objects: every address any plane
-    listens or sends on maps its PORT to the authority's index (sorted-key
-    order, matching LeaderElector)."""
-    out: dict[int, int] = {}
-    for committee in committees:
-        names = sorted(committee.authorities.keys())
-        for i, name in enumerate(names):
-            auth = committee.authorities[name]
-            for attr in ("address", "mempool_address", "front_address"):
-                addr = getattr(auth, attr, None)
-                if addr is not None:
-                    out[addr[1]] = i
-    return out

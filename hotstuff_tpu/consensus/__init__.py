@@ -1,17 +1,34 @@
 from .config import Authority, Committee, Parameters
 from .consensus import Consensus
-from .messages import QC, TC, Block, LoopBack, Round, SyncRequest, Timeout, Vote
+from .messages import (
+    QC,
+    TC,
+    Block,
+    LoopBack,
+    Round,
+    SyncRangeReply,
+    SyncRangeRequest,
+    SyncRequest,
+    Timeout,
+    Vote,
+)
+from .reconfig import EpochChange, EpochManager, EpochSchedule
 
 __all__ = [
     "Authority",
     "Committee",
     "Parameters",
     "Consensus",
+    "EpochChange",
+    "EpochManager",
+    "EpochSchedule",
     "QC",
     "TC",
     "Block",
     "LoopBack",
     "Round",
+    "SyncRangeReply",
+    "SyncRangeRequest",
     "SyncRequest",
     "Timeout",
     "Vote",

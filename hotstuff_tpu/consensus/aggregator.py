@@ -18,6 +18,7 @@ from ..utils import metrics, tracing
 from .config import Committee
 from .errors import UnknownAuthorityError, ensure
 from .messages import QC, TC, Round, Timeout, Vote
+from .reconfig import as_manager
 
 # qc_form_s / tc_form_s: first vote (or timeout) appended -> quorum fired —
 # the vote->QC leg of the proposal->vote->QC->commit latency chain.
@@ -91,7 +92,11 @@ class TCMaker:
 
 class Aggregator:
     def __init__(self, committee: Committee, verification_service=None) -> None:
-        self.committee = committee
+        # Committee or reconfig.EpochManager: stake weights and quorum
+        # thresholds resolve against the committee of the VOTE's round, so
+        # a QC forming across an epoch boundary counts the right epoch's
+        # validators on each side.
+        self.epochs = as_manager(committee)
         self.votes_aggregators: dict[tuple[Round, Digest], QCMaker] = {}
         self.timeouts_aggregators: dict[Round, TCMaker] = {}
         # Votes/timeouts reaching the aggregator were already verified by
@@ -99,6 +104,10 @@ class Aggregator:
         # means the QC/TC assembled from them re-verifies ZERO signatures
         # (each signature is otherwise checked 2-3x over its lifetime).
         self.verification_service = verification_service
+
+    @property
+    def committee(self) -> Committee:
+        return self.epochs.current()
 
     def _seed(self, digest: Digest, author: PublicKey, sig: Signature) -> None:
         svc = self.verification_service
@@ -112,13 +121,13 @@ class Aggregator:
         advance."""
         key = (vote.round, vote.hash)
         maker = self.votes_aggregators.setdefault(key, QCMaker())
-        qc = maker.append(vote, self.committee)
+        qc = maker.append(vote, self.epochs.committee_for_round(vote.round))
         self._seed(vote.signed_digest(), vote.author, vote.signature)
         return qc
 
     def add_timeout(self, timeout: Timeout) -> TC | None:
         maker = self.timeouts_aggregators.setdefault(timeout.round, TCMaker())
-        tc = maker.append(timeout, self.committee)
+        tc = maker.append(timeout, self.epochs.committee_for_round(timeout.round))
         self._seed(
             timeout.signed_digest(), timeout.author, timeout.signature
         )

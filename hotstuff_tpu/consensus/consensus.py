@@ -10,6 +10,7 @@ import logging
 
 from ..crypto import PublicKey, SignatureService
 from ..network import NetReceiver, NetSender
+from ..network.net import Address
 from ..store import Store
 from ..utils.actors import channel, spawn
 from .config import Committee, Parameters
@@ -17,6 +18,7 @@ from .core import Core
 from .leader import LeaderElector
 from .mempool_driver import MempoolDriver
 from .messages import decode_consensus_message
+from .reconfig import EpochManager, as_manager
 from .synchronizer import Synchronizer
 
 log = logging.getLogger("hotstuff.consensus")
@@ -34,12 +36,22 @@ class Consensus:
         commit_channel: asyncio.Queue,
         core_channel: asyncio.Queue | None = None,
         verification_service=None,
+        epoch_manager: EpochManager | None = None,
+        listen_address: Address | None = None,
     ) -> Core:
         """Boot the consensus plane; returns the Core (its actor task is
         spawned). The committee addresses are this plane's listen ports.
         `core_channel` may be supplied by the composition root so other
         subsystems (the mempool payload synchronizer) can LoopBack blocks
-        into the core (node/src/node.rs:34-89 channel wiring)."""
+        into the core (node/src/node.rs:34-89 channel wiring).
+
+        `epoch_manager` (reconfig.py) is shared by the core, leader
+        elector, aggregator and synchronizer, so a committed epoch change
+        moves them to the successor committee atomically; one is built
+        from the genesis committee when not supplied. `listen_address`
+        covers a node that is NOT in the genesis committee — a validator
+        expecting to JOIN at a later epoch boundary still needs a bound
+        port to catch up and participate from."""
         # NOTE: boot-time config echo; parsed by the benchmark harness.
         parameters.log(log)
 
@@ -47,8 +59,11 @@ class Consensus:
             core_channel = channel()
         network_tx = channel()
 
-        address = committee.address(name)
-        assert address is not None, "node must be in the committee"
+        epochs = epoch_manager if epoch_manager is not None else as_manager(committee)
+        address = committee.address(name) or listen_address
+        assert address is not None, (
+            "node must be in the committee or supply listen_address"
+        )
         NetReceiver(
             ("0.0.0.0", address[1]),
             core_channel,
@@ -57,11 +72,11 @@ class Consensus:
         )
         NetSender(network_tx, name="consensus-sender")
 
-        leader_elector = LeaderElector(committee)
+        leader_elector = LeaderElector(epochs)
         mempool_driver = MempoolDriver(mempool_channel)
         synchronizer = Synchronizer(
             name,
-            committee,
+            epochs,
             store,
             network_tx,
             core_channel,
@@ -69,7 +84,7 @@ class Consensus:
         )
         core = Core(
             name,
-            committee,
+            epochs,
             parameters,
             signature_service,
             store,

@@ -38,17 +38,26 @@ from .errors import ConsensusError, WrongLeaderError, ensure
 from .leader import LeaderElector
 from .mempool_driver import MempoolDriver
 from .messages import (
+    MAX_RANGE_BATCH,
     QC,
     TC,
     Block,
     LoopBack,
     Round,
+    SyncRangeReply,
+    SyncRangeRequest,
     SyncRequest,
     Timeout,
     Vote,
     encode_consensus_message,
 )
-from .synchronizer import Synchronizer
+from .reconfig import EpochChange, MIN_ACTIVATION_MARGIN, as_manager
+from .synchronizer import (
+    RANGE_SYNC_THRESHOLD,
+    RANGE_WALK_CAP,
+    Synchronizer,
+    collect_range,
+)
 
 log = logging.getLogger("hotstuff.consensus")
 
@@ -63,6 +72,11 @@ _M_SYNC_SERVED = metrics.counter("consensus.sync_requests_served")
 _M_ROUND = metrics.gauge("consensus.round")
 _M_PROPOSAL_TO_VOTE = metrics.histogram("consensus.proposal_to_vote_s")
 _M_COMMIT_LATENCY = metrics.histogram("consensus.commit_latency_s")
+_M_RECONFIG_PROPOSED = metrics.counter("reconfig.proposed")
+_M_RANGE_SERVED = metrics.counter("sync.range_served")
+_M_RANGE_REPLIES = metrics.counter("sync.range_replies")
+_M_RANGE_BLOCKS = metrics.counter("sync.range_blocks")
+_M_PARKED = metrics.counter("sync.parked_blocks")
 
 # Cap on the first-seen timestamp map feeding commit_latency_s: Byzantine
 # proposals that never commit must not grow it without bound.
@@ -88,7 +102,11 @@ class Core:
         from ..crypto.batch_service import BatchVerificationService
 
         self.name = name
-        self.committee = committee
+        # `committee` may be a static Committee or a reconfig.EpochManager;
+        # either way the epoch manager is the single round -> committee
+        # authority for this core (and is shared with the leader elector,
+        # aggregator and synchronizer when wired by Consensus.run).
+        self.epochs = as_manager(committee)
         self.parameters = parameters
         self.signature_service = signature_service
         # Off-loop batched verification: QC/TC/vote signature checks coalesce
@@ -111,14 +129,44 @@ class Core:
         self.high_qc: QC = QC.genesis()
         # The aggregator seeds verified vote/timeout signatures into the
         # service's dedup cache, so assembled QCs/TCs short-circuit.
-        self.aggregator = Aggregator(committee, self.verification_service)
+        self.aggregator = Aggregator(self.epochs, self.verification_service)
         self.timer: Timer | None = None  # created inside the running loop
+        # EpochChange queued for this node's next proposal (schedule_reconfig)
+        self._pending_reconfig: EpochChange | None = None
+        # Single-slot serve cache for chained range-sync batches:
+        # (target digest bytes, walk floor, ancestor chain oldest-first).
+        # Safe to reuse — a target's ancestry is immutable chain content.
+        self._range_walk: tuple[bytes, Round, list[Block]] | None = None
         # Pacemaker backoff state: consecutive local timeouts without an
         # intervening QC-driven round advance (see Parameters.timeout_backoff).
         self._consecutive_timeouts = 0
         # block digest -> first-seen monotonic time, for commit_latency_s
         # (insertion-ordered; bounded by _SEEN_CAP, oldest evicted).
         self._block_seen: dict[Digest, float] = {}
+
+    @property
+    def committee(self):
+        """The committee governing the CURRENT round (epoch-resolved)."""
+        return self.epochs.committee_for_round(self.round)
+
+    def schedule_reconfig(self, change: EpochChange) -> None:
+        """Queue a committee change for this node's next proposal. Carried
+        until a proposal includes it; silently dropped once stale (the
+        target epoch activated, or the activation round is no longer far
+        enough ahead to commit first)."""
+        self._pending_reconfig = change
+
+    def _take_reconfig(self) -> EpochChange | None:
+        change = self._pending_reconfig
+        if change is None:
+            return None
+        if (
+            change.new_epoch != self.epochs.applied_epoch + 1
+            or change.activation_round < self.round + MIN_ACTIVATION_MARGIN
+        ):
+            self._pending_reconfig = None  # applied elsewhere, or too late
+            return None
+        return change
 
     # -- persistence of safety-critical state (fixes reference issue #15) ----
 
@@ -148,20 +196,29 @@ class Core:
     # -- helpers -------------------------------------------------------------
 
     async def _transmit(
-        self, msg, to: PublicKey | None, trace: "tracing.TraceContext | None" = None
+        self,
+        msg,
+        to: PublicKey | None,
+        trace: "tracing.TraceContext | None" = None,
+        urgent: bool = False,
     ) -> None:
         """Send to one authority, or broadcast to all others when to is None
         (consensus/src/synchronizer.rs:109-129 transmit helper). `trace`
         rides the frame trailer (utils/tracing.py) for cross-node
-        commit-latency attribution."""
+        commit-latency attribution. Direct sends resolve the address
+        across every known epoch (a catch-up reply may target a peer only
+        present in the adjacent epoch's committee); `urgent` selects the
+        network's hot egress lane (sync recovery replies)."""
         data = encode_consensus_message(msg)
         if to is not None:
-            addr = self.committee.address(to)
+            addr = self.epochs.address(to)
             addrs = [addr] if addr else []
         else:
             addrs = self.committee.broadcast_addresses(self.name)
         if addrs:
-            await self.network_tx.put(NetMessage(data, addrs, trace=trace))
+            await self.network_tx.put(
+                NetMessage(data, addrs, urgent=urgent, trace=trace)
+            )
 
     @staticmethod
     def _trace_ctx(round_: Round, digest: Digest) -> "tracing.TraceContext | None":
@@ -202,9 +259,14 @@ class Core:
         )
         return Vote(digest, block.round, self.name, signature)
 
-    async def _commit(self, block: Block) -> None:
+    async def _commit(self, block: Block, child: Block, grandchild: Block) -> None:
         """Commit `block` and all uncommitted ancestors, oldest first
-        (core.rs:125-165)."""
+        (core.rs:125-165). `child`/`grandchild` are the caller's b1 and
+        the block under processing — the chain continuation above
+        `block`, giving each committed EpochChange's LOCAL commit
+        position for the late-apply observability check
+        (reconfig.EpochManager.apply; the boundary itself stays the
+        declared activation round)."""
         if self.last_committed_round >= block.round:
             return
         to_commit = [block]
@@ -222,7 +284,27 @@ class Core:
                 break
             to_commit.append(parent)
         self.last_committed_round = block.round
+        # Commit-path synchronizer hygiene: the committed floor gates the
+        # range-sync threshold, and fetches/waiters for branches at or
+        # below it are abandoned forks to reclaim (the old leak).
+        self.synchronizer.note_committed(block.round)
+        self.synchronizer.cleanup(block.round)
         now = time.perf_counter()
+        # to_commit is NEWEST-first: index i's chain grandchild is
+        # to_commit[i-2], falling back to the caller's continuation for
+        # the two newest entries. Applied oldest-first so stacked epoch
+        # changes in one commit cascade sequence correctly.
+        chain_above = {0: grandchild, 1: child}
+        for i in range(len(to_commit) - 1, -1, -1):
+            b = to_commit[i]
+            if b.reconfig is not None:
+                trigger = chain_above[i] if i < 2 else to_commit[i - 2]
+                # The epoch-commit rule: the successor committee schedules
+                # only HERE, when the carrying block is 2-chain committed
+                # (apply is idempotent — a change can ride several blocks).
+                await self.epochs.apply(
+                    b.reconfig, store=self.store, trigger_round=trigger.round
+                )
         for b in reversed(to_commit):
             d = b.digest()
             _M_COMMITS.inc()
@@ -268,6 +350,9 @@ class Core:
             return
         self.round = round_ + 1
         _M_ROUND.set(self.round)
+        # The epoch manager's current() (broadcast fan-out, synchronizer
+        # peer picks) follows the newest round this core has reached.
+        self.epochs.note_round(self.round)
         log.debug("Moved to round %s", self.round)
         if self.timer is not None:
             self.timer.reset()
@@ -325,12 +410,21 @@ class Core:
         t0 = time.perf_counter()
         payload = await self.mempool_driver.get(self.parameters.max_payload_size)
         payload_dur = time.perf_counter() - t0
-        digest = Block.make_digest(self.name, self.round, payload, self.high_qc)
+        reconfig = self._take_reconfig()
+        digest = Block.make_digest(
+            self.name, self.round, payload, self.high_qc, reconfig
+        )
         signature = await self.signature_service.request_signature(digest)
         block = Block(
-            self.high_qc, tc, self.name, self.round, tuple(payload), signature
+            self.high_qc, tc, self.name, self.round, tuple(payload), signature,
+            reconfig,
         )
         _M_PROPOSALS.inc()
+        if reconfig is not None:
+            _M_RECONFIG_PROPOSED.inc()
+            log.info(
+                "Proposing %s in B%s", reconfig, block.round
+            )
         if tracing.enabled():
             tid = tracing.trace_id(block.round, digest.data)
             tracing.event("propose", tid, origin=True)
@@ -344,7 +438,7 @@ class Core:
         await self._transmit(block, None, trace=self._trace_ctx(block.round, digest))
         await self._process_block(block)
 
-    async def _process_block(self, block: Block) -> None:
+    async def _process_block(self, block: Block, replay: bool = False) -> None:
         """Ordering + commit logic (core.rs:327-378)."""
         t0 = time.perf_counter()
         ancestors = await self.synchronizer.get_ancestors(block)
@@ -359,10 +453,15 @@ class Core:
 
         # 2-chain commit rule.
         if b0.round + 1 == b1.round:
-            await self._commit(b0)
+            await self._commit(b0, b1, block)
         await self.mempool_driver.cleanup(b0, b1, block)
 
-        if block.round != self.round:
+        if replay or block.round != self.round:
+            # Replayed (range-synced) blocks are historical: their QCs
+            # already exist, so voting would only burn a signing + a
+            # durable safety-state write + a stale frame per ancient
+            # block — the round-match gate alone misses this on a node
+            # whose round is still dragging up through the replay.
             return
         # NOTE: deliberately NO timer reset here. The pacemaker re-arms only
         # on round ADVANCE (core.rs:267-268): resetting on every current-round
@@ -390,7 +489,7 @@ class Core:
 
     # -- message handlers ----------------------------------------------------
 
-    async def _handle_proposal(self, block: Block) -> None:
+    async def _handle_proposal(self, block: Block, replay: bool = False) -> None:
         digest = block.digest()
         # Disabled-mode fast path: skip the trace-id formatting and the
         # extra clock reads entirely (tid=None keeps service groups untagged).
@@ -398,14 +497,46 @@ class Core:
         tid = tracing.trace_id(block.round, digest.data) if traced else None
         if traced:
             tracing.event("propose", tid)
-        leader = self.leader_elector.get_leader(block.round)
-        ensure(
-            block.author == leader, WrongLeaderError(block.round, block.author, leader)
-        )
         t0 = time.perf_counter()
-        await block.verify_async(
-            self.committee, self.verification_service, trace=tid
-        )
+        try:
+            leader = self.leader_elector.get_leader(block.round)
+            ensure(
+                block.author == leader,
+                WrongLeaderError(block.round, block.author, leader),
+            )
+            await block.verify_async(
+                self.epochs, self.verification_service, trace=tid
+            )
+            if block.reconfig is not None:
+                # Epoch sequencing + activation-margin admission (the
+                # signature already rode the verify_async group).
+                self.epochs.validate(block.reconfig, block.round)
+        except ConsensusError:
+            if (
+                block.round > self.round + RANGE_SYNC_THRESHOLD
+                and await self.store.read(block.parent().data) is None
+            ):
+                # Catch-up seam: a block this far past our round may be
+                # certified by a committee epoch we have not COMMITTED yet
+                # (reconfig.py), in which case every check above judges it
+                # with stale epoch knowledge. Park it unverified, fetch
+                # its claimed ancestry (range sync), and re-validate from
+                # scratch when the parent arrives. Nothing is trusted
+                # until that second pass succeeds. The parent-missing
+                # guard matters: with the parent present this IS the
+                # second pass — a failure now is genuine garbage, and
+                # re-parking it would spin (the waiter fires instantly).
+                if await self.synchronizer.fetch_unverified(block):
+                    _M_PARKED.inc()
+                    log.info(
+                        "parking unverifiable B%s (%s rounds past local "
+                        "round %s) pending ancestry sync",
+                        block.round,
+                        block.round - self.round,
+                        self.round,
+                    )
+                    return
+            raise
         if traced:
             dur = time.perf_counter() - t0
             tracing.event("verify", tid, dur)
@@ -430,7 +561,7 @@ class Core:
         if not available:
             log.debug("%s waiting for payload availability", block)
             return
-        await self._process_block(block)
+        await self._process_block(block, replay=replay)
 
     async def _handle_vote(self, vote: Vote) -> None:
         if vote.round < self.round:
@@ -439,7 +570,7 @@ class Core:
         tid = tracing.trace_id(vote.round, vote.hash.data) if traced else None
         t0 = time.perf_counter()
         await vote.verify_async(
-            self.committee, self.verification_service, trace=tid
+            self.epochs, self.verification_service, trace=tid
         )
         if traced:
             tracing.event("verify", tid, time.perf_counter() - t0, vote=True)
@@ -453,7 +584,7 @@ class Core:
     async def _handle_timeout(self, timeout: Timeout) -> None:
         if timeout.round < self.round:
             return
-        await timeout.verify_async(self.committee, self.verification_service)
+        await timeout.verify_async(self.epochs, self.verification_service)
         await self._process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
@@ -465,7 +596,7 @@ class Core:
 
     async def _handle_tc(self, tc: TC) -> None:
         """A TC received directly (core.rs:438-444)."""
-        await tc.verify_async(self.committee, self.verification_service)
+        await tc.verify_async(self.epochs, self.verification_service)
         await self._advance_round(tc.round)
         if self.leader_elector.get_leader(self.round) == self.name:
             await self._generate_proposal(tc)
@@ -477,12 +608,89 @@ class Core:
             return
         _M_SYNC_SERVED.inc()
         block = Block.decode(Reader(raw))
-        await self._transmit(block, request.requester)
+        await self._transmit(block, request.requester, urgent=True)
+
+    async def _handle_sync_range_request(self, request: SyncRangeRequest) -> None:
+        """Serve a catch-up batch: the ancestor chain ending at the
+        requested target, oldest-first, capped (synchronizer.collect_range).
+        Unknown targets are ignored — the requester's retry escalation
+        finds a peer that has it.
+
+        A chained catch-up re-requests the SAME target with a rising
+        from_round; re-walking the whole ancestry per batch would make
+        the serve side quadratic in the gap (each walk reads+decodes up
+        to the full chain to find the oldest 64 blocks). The single-slot
+        walk cache keeps the full (walk-capped) chain for the last
+        target: one walk per catch-up, a slice per batch."""
+        cached = self._range_walk
+        if (
+            cached is not None
+            and cached[0] == request.target.data
+            and request.from_round >= cached[1]
+        ):
+            chain = cached[2]
+        else:
+            chain = await collect_range(
+                self.store, request.target, request.from_round, cap=RANGE_WALK_CAP
+            )
+            self._range_walk = (request.target.data, request.from_round, chain)
+        blocks = [b for b in chain if b.round > request.from_round][:MAX_RANGE_BATCH]
+        if not blocks:
+            return
+        _M_RANGE_SERVED.inc()
+        await self._transmit(
+            SyncRangeReply(request.target, tuple(blocks)),
+            request.requester,
+            urgent=True,
+        )
+
+    async def _handle_sync_range_reply(self, reply: SyncRangeReply) -> None:
+        """Ingest a catch-up batch. Every block runs the FULL proposal
+        path (leader check, batched signature verification, per-epoch QC
+        quorums, ordering, commit rule) in oldest-first order, so epoch
+        switches committed mid-batch govern the validation of the blocks
+        that follow them. A block that fails aborts the rest of the batch
+        (later blocks depend on it); already-stored blocks are skipped, so
+        duplicate replies from an escalated broadcast are cheap."""
+        if not reply.blocks:
+            return
+        _M_RANGE_REPLIES.inc()
+        processed = 0
+        for block in reply.blocks:
+            if await self.store.read(block.digest().data) is not None:
+                continue
+            try:
+                await self._handle_proposal(block, replay=True)
+            except ConsensusError as e:
+                log.warning("range-sync block %s rejected: %s", block, e)
+                break
+            processed += 1
+        if not processed:
+            return
+        _M_RANGE_BLOCKS.inc(processed)
+        # NOTE: parsed by the benchmark LogParser (catch-up progress).
+        log.info("Range sync fetched %s blocks", processed)
+        if await self.store.read(reply.target.data) is None:
+            # Still short of the target: chain the next batch eagerly off
+            # the advanced committed floor instead of waiting out a retry.
+            await self.synchronizer.continue_range(reply.target)
+        else:
+            log.info(
+                "Range sync caught up: target %s resolved at round %s",
+                reply.target.short(),
+                self.last_committed_round,
+            )
 
     # -- main loop -----------------------------------------------------------
 
     async def run(self) -> None:
         await self._load_safety_state()
+        # Rebuild committed epoch boundaries BEFORE processing traffic: a
+        # node restarting past a committee switch must judge certificates
+        # with the epoch knowledge its crashed incarnation had persisted.
+        await self.epochs.load(self.store)
+        self.epochs.note_round(self.round)
+        self.synchronizer.note_committed(self.last_committed_round)
         self.timer = Timer(self.parameters.timeout_delay)
 
         # Bootstrap: the round-1 leader proposes immediately (core.rs:446-454).
@@ -516,6 +724,10 @@ class Core:
                     await self._handle_tc(value)
                 elif isinstance(value, SyncRequest):
                     await self._handle_sync_request(value)
+                elif isinstance(value, SyncRangeRequest):
+                    await self._handle_sync_range_request(value)
+                elif isinstance(value, SyncRangeReply):
+                    await self._handle_sync_range_reply(value)
                 elif isinstance(value, LoopBack):
                     await self._process_block(value.block)
                 else:

@@ -40,6 +40,11 @@ class MalformedBlockError(ConsensusError):
     pass
 
 
+class ReconfigError(ConsensusError):
+    """An EpochChange that violates the epoch-commit rule's admission
+    checks (sequence, activation margin, empty successor set)."""
+
+
 def ensure(cond: bool, err: ConsensusError) -> None:
     """The reference's ensure! macro (consensus/src/error.rs)."""
     if not cond:

@@ -1,16 +1,22 @@
 """Leader election (reference consensus/src/leader.rs:16-20):
-round-robin over the sorted authority keys."""
+round-robin over the sorted authority keys — of the committee governing
+the round, so rotation crosses epoch boundaries with the committee
+(consensus/reconfig.py): a joined validator enters the rotation at its
+epoch's activation round and a departed one leaves it."""
 
 from __future__ import annotations
 
 from ..crypto import PublicKey
 from .config import Committee
-from .messages import Round
+from .reconfig import Round, as_manager
 
 
 class LeaderElector:
     def __init__(self, committee: Committee) -> None:
-        self._keys: list[PublicKey] = committee.sorted_keys()
+        # Committee or reconfig.EpochManager (per-epoch sorted keys are
+        # cached inside the schedule — this resolves every round).
+        self._epochs = as_manager(committee)
 
     def get_leader(self, round_: Round) -> PublicKey:
-        return self._keys[round_ % len(self._keys)]
+        keys = self._epochs.schedule.sorted_keys_for_round(round_)
+        return keys[round_ % len(keys)]

@@ -29,8 +29,25 @@ from .errors import (
     UnknownAuthorityError,
     ensure,
 )
+from .reconfig import EpochChange
 
 Round = int  # u64
+
+# Upper bound on blocks per SyncRangeReply: bounds the serve-side store
+# walk, the reply frame size, and what a receiver will decode from an
+# unauthenticated peer (the blocks themselves are self-verifying).
+MAX_RANGE_BATCH = 64
+
+
+def _committee_at(committee, round_: Round) -> Committee:
+    """Resolve the committee governing `round_`. Verification paths accept
+    either a bare Committee (static, the pre-reconfig behaviour) or an
+    epoch resolver (reconfig.EpochManager / EpochSchedule): with dynamic
+    reconfiguration, a certificate's quorum is judged against the
+    committee of the certificate's OWN epoch — a boundary block's
+    embedded QC may belong to the epoch before the block's."""
+    resolver = getattr(committee, "committee_for_round", None)
+    return committee if resolver is None else resolver(round_)
 
 
 def _vote_digest(hash_: Digest, round_: Round) -> Digest:
@@ -80,8 +97,10 @@ class QC:
 
     def check_quorum(self, committee: Committee) -> None:
         """Structural checks only: authority uniqueness, known stake, 2f+1
-        weight (messages.rs:180-196). Signature checks are separate so the
+        weight (messages.rs:180-196) — against the committee of THIS QC's
+        round/epoch (`_committee_at`). Signature checks are separate so the
         async path can batch them through the verification service."""
+        committee = _committee_at(committee, self.round)
         weight = 0
         used: set[PublicKey] = set()
         for name, _ in self.votes:
@@ -147,6 +166,7 @@ class TC:
         return [r for _, _, r in self.votes]
 
     def check_quorum(self, committee: Committee) -> None:
+        committee = _committee_at(committee, self.round)
         weight = 0
         used: set[PublicKey] = set()
         for name, _, _ in self.votes:
@@ -214,6 +234,10 @@ class Block:
     round: Round
     payload: tuple[Digest, ...]
     signature: Signature
+    # Optional committee-succession payload (consensus/reconfig.py): the
+    # block digest commits to it, and the new committee activates only
+    # once THIS block is 2-chain committed (the epoch-commit rule).
+    reconfig: EpochChange | None = None
     # digest cache: read on every vote/store/commit/sync touch
     _digest: Digest | None = field(
         default=None, init=False, repr=False, compare=False
@@ -238,7 +262,9 @@ class Block:
             object.__setattr__(
                 self,
                 "_digest",
-                Block.make_digest(self.author, self.round, self.payload, self.qc),
+                Block.make_digest(
+                    self.author, self.round, self.payload, self.qc, self.reconfig
+                ),
             )
         return self._digest
 
@@ -247,12 +273,22 @@ class Block:
 
     @staticmethod
     def make_digest(
-        author: PublicKey, round_: Round, payload: list[Digest], qc: QC
+        author: PublicKey,
+        round_: Round,
+        payload: list[Digest],
+        qc: QC,
+        reconfig: EpochChange | None = None,
     ) -> Digest:
         h = b"HSBLOCK" + author.data + struct.pack("<Q", round_)
         for d in payload:
             h += d.data
         h += qc.hash.data + struct.pack("<Q", qc.round)
+        if reconfig is not None:
+            # Committed-to ONLY when present: reconfig-free blocks keep the
+            # historical preimage byte-for-byte, and a relay can neither
+            # strip nor alter a carried change without breaking the
+            # author's signature over this digest.
+            h += b"HSEPOCH" + reconfig.digest().data
         return Digest(sha512_32(h))
 
     @staticmethod
@@ -263,31 +299,51 @@ class Block:
         round_: Round,
         payload: list[Digest],
         secret: SecretKey,
+        reconfig: EpochChange | None = None,
     ) -> "Block":
         """Sync constructor bypassing the SignatureService, as the reference
         test fixtures do (consensus/src/tests/common.rs:44-61)."""
-        digest = Block.make_digest(author, round_, payload, qc)
-        return Block(qc, tc, author, round_, tuple(payload), Signature.new(digest, secret))
+        digest = Block.make_digest(author, round_, payload, qc, reconfig)
+        return Block(
+            qc, tc, author, round_, tuple(payload),
+            Signature.new(digest, secret), reconfig,
+        )
 
     def verify(self, committee: Committee) -> None:
         """Ingress checks (consensus/src/messages.rs:55-76): known author with
-        stake, author signature, embedded QC, embedded TC."""
-        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        stake, author signature, embedded QC, embedded TC, carried epoch
+        change. Author stake resolves against the committee of THIS
+        block's round; the certificates resolve against their own rounds
+        inside their check_quorum."""
+        own = _committee_at(committee, self.round)
+        ensure(own.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = self.signature.verify(self.digest(), self.author)
         ensure(ok, InvalidSignatureError(f"bad block signature B{self.round}"))
         if not self.qc.is_genesis():
             self.qc.verify(committee)
         if self.tc is not None:
             self.tc.verify(committee)
+        if self.reconfig is not None:
+            ensure(
+                own.stake(self.reconfig.author) > 0,
+                UnknownAuthorityError(self.reconfig.author),
+            )
+            ok = self.reconfig.signature.verify(
+                self.reconfig.digest(), self.reconfig.author
+            )
+            ensure(
+                ok, InvalidSignatureError(f"bad epoch-change signature B{self.round}")
+            )
 
     async def verify_async(
         self, committee: Committee, service, trace: str | None = None
     ) -> None:
         """verify() with ALL signature checks (author + embedded QC + embedded
-        TC) submitted as ONE group to the BatchVerificationService: a single
-        coalesced backend dispatch per block instead of three synchronous
-        calls in the consensus actor loop."""
-        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        TC + carried epoch change) submitted as ONE group to the
+        BatchVerificationService: a single coalesced backend dispatch per
+        block instead of synchronous calls in the consensus actor loop."""
+        own = _committee_at(committee, self.round)
+        ensure(own.stake(self.author) > 0, UnknownAuthorityError(self.author))
         msgs: list[bytes] = [self.digest().data]
         pairs: list[tuple[PublicKey, Signature]] = [(self.author, self.signature)]
         qc_lo = qc_hi = tc_lo = tc_hi = len(msgs)
@@ -303,6 +359,17 @@ class Block:
             tc_lo, tc_hi = len(msgs), len(msgs) + len(m)
             msgs += m
             pairs += p
+        ec_lo = len(msgs)
+        if self.reconfig is not None:
+            # The change must be signed by a CURRENT (block-round) epoch
+            # authority; the successor committee governs nothing until the
+            # carrying block commits and the activation round arrives.
+            ensure(
+                own.stake(self.reconfig.author) > 0,
+                UnknownAuthorityError(self.reconfig.author),
+            )
+            msgs.append(self.reconfig.digest().data)
+            pairs.append((self.reconfig.author, self.reconfig.signature))
         mask = await service.verify_group(
             msgs, pairs, urgent=True, committee=True, trace=trace,
             source="consensus"
@@ -316,6 +383,10 @@ class Block:
             all(mask[tc_lo:tc_hi]),
             InvalidSignatureError("TC batch verification failed"),
         )
+        ensure(
+            all(mask[ec_lo:]),
+            InvalidSignatureError(f"bad epoch-change signature B{self.round}"),
+        )
 
     def encode(self, w: Writer) -> None:
         self.qc.encode(w)
@@ -328,6 +399,11 @@ class Block:
         w.u64(self.round)
         w.seq(list(self.payload), lambda wr, d: wr.fixed(d.data, 32))
         w.fixed(self.signature.data, 64)
+        if self.reconfig is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            self.reconfig.encode(w)
 
     @staticmethod
     def decode(r: Reader) -> "Block":
@@ -337,7 +413,8 @@ class Block:
         round_ = r.u64()
         payload = tuple(r.seq(lambda rd: Digest(rd.fixed(32))))
         sig = Signature(r.fixed(64))
-        return Block(qc, tc, author, round_, payload, sig)
+        reconfig = EpochChange.decode(r) if r.u8() else None
+        return Block(qc, tc, author, round_, payload, sig, reconfig)
 
     def size(self) -> int:
         w = Writer()
@@ -368,6 +445,7 @@ class Vote:
         return _vote_digest(self.hash, self.round)
 
     def verify(self, committee: Committee) -> None:
+        committee = _committee_at(committee, self.round)
         ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = self.signature.verify(self.signed_digest(), self.author)
         ensure(ok, InvalidSignatureError(f"bad vote signature V{self.round}"))
@@ -375,6 +453,7 @@ class Vote:
     async def verify_async(
         self, committee: Committee, service, trace: str | None = None
     ) -> None:
+        committee = _committee_at(committee, self.round)
         ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = await service.verify(
             self.signed_digest().data, self.author, self.signature,
@@ -419,7 +498,8 @@ class Timeout:
         return _timeout_digest(self.round, self.high_qc.round)
 
     def verify(self, committee: Committee) -> None:
-        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        own = _committee_at(committee, self.round)
+        ensure(own.stake(self.author) > 0, UnknownAuthorityError(self.author))
         ok = self.signature.verify(self.signed_digest(), self.author)
         ensure(ok, InvalidSignatureError(f"bad timeout signature T{self.round}"))
         if not self.high_qc.is_genesis():
@@ -429,7 +509,8 @@ class Timeout:
         self, committee: Committee, service, trace: str | None = None
     ) -> None:
         """Timeout signature + embedded high_qc votes as one service group."""
-        ensure(committee.stake(self.author) > 0, UnknownAuthorityError(self.author))
+        own = _committee_at(committee, self.round)
+        ensure(own.stake(self.author) > 0, UnknownAuthorityError(self.author))
         msgs: list[bytes] = [self.signed_digest().data]
         pairs: list[tuple[PublicKey, Signature]] = [(self.author, self.signature)]
         if not self.high_qc.is_genesis():
@@ -471,6 +552,8 @@ TAG_VOTE = 1
 TAG_TIMEOUT = 2
 TAG_TC = 3
 TAG_SYNC_REQUEST = 4
+TAG_SYNC_RANGE_REQUEST = 5
+TAG_SYNC_RANGE_REPLY = 6
 
 
 def encode_consensus_message(msg) -> bytes:
@@ -491,6 +574,17 @@ def encode_consensus_message(msg) -> bytes:
         w.u8(TAG_SYNC_REQUEST)
         w.fixed(msg.digest.data, 32)
         w.fixed(msg.requester.data, 32)
+    elif isinstance(msg, SyncRangeRequest):
+        w.u8(TAG_SYNC_RANGE_REQUEST)
+        w.fixed(msg.target.data, 32)
+        w.u64(msg.from_round)
+        w.fixed(msg.requester.data, 32)
+    elif isinstance(msg, SyncRangeReply):
+        if len(msg.blocks) > MAX_RANGE_BATCH:
+            raise ValueError(f"range reply over batch cap: {len(msg.blocks)}")
+        w.u8(TAG_SYNC_RANGE_REPLY)
+        w.fixed(msg.target.data, 32)
+        w.seq(list(msg.blocks), lambda wr, b: b.encode(wr))
     else:
         raise TypeError(f"not a consensus message: {msg!r}")
     return w.bytes()
@@ -509,6 +603,19 @@ def decode_consensus_message(data: bytes):
         out = TC.decode(r)
     elif tag == TAG_SYNC_REQUEST:
         out = SyncRequest(Digest(r.fixed(32)), PublicKey(r.fixed(32)))
+    elif tag == TAG_SYNC_RANGE_REQUEST:
+        out = SyncRangeRequest(
+            Digest(r.fixed(32)), r.u64(), PublicKey(r.fixed(32))
+        )
+    elif tag == TAG_SYNC_RANGE_REPLY:
+        target = Digest(r.fixed(32))
+        blocks = tuple(r.seq(Block.decode))
+        if len(blocks) > MAX_RANGE_BATCH:
+            # Defensive cap BEFORE anything downstream trusts the batch:
+            # an unauthenticated peer must not make us buffer an
+            # arbitrarily long chain segment per frame.
+            raise SerdeError(f"range reply over batch cap: {len(blocks)}")
+        out = SyncRangeReply(target, blocks)
     else:
         raise SerdeError(f"unknown consensus tag {tag}")
     r.expect_done()
@@ -521,6 +628,31 @@ class SyncRequest:
 
     digest: Digest
     requester: PublicKey
+
+
+@dataclass(frozen=True, slots=True)
+class SyncRangeRequest:
+    """Batched catch-up fetch: ask for the ancestor chain of `target`
+    down to (exclusive) `from_round` — the requester's committed round,
+    below which the chains must coincide. The serving peer walks its
+    store back from `target` and answers with ONE SyncRangeReply of up
+    to MAX_RANGE_BATCH blocks, OLDEST first, so the receiver can verify
+    and commit progressively (each block's parent precedes it)."""
+
+    target: Digest
+    from_round: Round
+    requester: PublicKey
+
+
+@dataclass(frozen=True, slots=True)
+class SyncRangeReply:
+    """Ancestor batch for a SyncRangeRequest (oldest-first, capped).
+    Unauthenticated as a message — each carried block is independently
+    verified through the normal proposal path, with QC quorums judged
+    against the committee of the QC's own epoch."""
+
+    target: Digest
+    blocks: tuple[Block, ...]
 
 
 @dataclass(frozen=True, slots=True)

@@ -1,0 +1,428 @@
+"""Dynamic validator reconfiguration: signed epoch changes, the
+epoch-commit rule, and per-round committee resolution.
+
+The operator set is no longer frozen at genesis (ROADMAP item 5). A
+committee change travels THROUGH the chain as a signed `EpochChange`
+carried by a proposal, and follows the epoch-commit rule of
+deterministic-finality designs (PAPERS.md, arXiv:2512.09409): the new
+committee takes effect only once the block carrying the change is
+2-chain COMMITTED, and then only from the change's declared
+`activation_round` onward. That gives every honest node the identical
+round -> committee mapping (it is a pure function of committed chain
+content), which is exactly what lets QC/TC quorums be verified against
+the committee of the certificate's OWN epoch on both sides of a
+boundary.
+
+Pieces:
+
+  * `EpochChange` — the wire payload: target epoch, activation round,
+    the full successor member list (key, stake, address), signed by a
+    current-epoch authority over a domain-separated digest. The block
+    digest commits to it (see `Block.make_digest`), so a relay cannot
+    strip or alter the change without invalidating the proposal.
+  * `EpochSchedule` — the pure round -> committee map: an ordered list
+    of (activation_round, committee) entries. Also used standalone by
+    the chaos SafetyChecker, which re-derives its OWN schedule from the
+    committed chain so invariant checking never trusts a node's state.
+  * `EpochManager` — a node's live view: schedule + validation of
+    proposed changes (epoch sequencing, activation margin), apply-on-
+    commit with store persistence (a restarted node must rebuild the
+    same mapping), current-committee resolution for transmit paths, and
+    the device-side hook: at a switch the active crypto backend's
+    committee table is re-registered (`register_committee`), whose
+    snapshot-pinned tables let in-flight chunks finish on the OLD
+    epoch (ops/ed25519.CommitteeTable, COMPONENTS.md §5.5c).
+
+Liveness note: `activation_round` must trail the carrying block by at
+least `MIN_ACTIVATION_MARGIN` rounds so the 2-chain commit lands before
+the boundary under normal operation. A node that reaches the boundary
+without the commit (it was crashed or partitioned) simply cannot verify
+new-epoch certificates yet — that is the catch-up path's job (range
+sync, consensus/synchronizer.py), not a safety hazard.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass
+from typing import Callable, Sequence
+
+from ..crypto import Digest, PublicKey, Signature, sha512_32
+from ..network.net import Address
+from ..utils import metrics
+from ..utils.serde import Reader, Writer
+from .config import Authority, Committee
+from .errors import ReconfigError, ensure
+
+log = logging.getLogger("hotstuff.consensus")
+
+Round = int
+
+# A proposed change must place its boundary at least this many rounds
+# past the carrying block, so the 2-chain commit normally lands first.
+MIN_ACTIVATION_MARGIN = 3
+
+_STORE_KEY = b"epoch-state"
+
+_M_SWITCHES = metrics.counter("reconfig.epoch_switches")
+_M_REJECTED = metrics.counter("reconfig.rejected")
+_M_LATE_APPLIES = metrics.counter("reconfig.late_applies")
+_M_EPOCH = metrics.gauge("reconfig.epoch")
+
+Member = tuple[PublicKey, int, Address]  # (key, stake, address)
+
+
+@dataclass(frozen=True, slots=True)
+class EpochChange:
+    """Signed committee-succession payload carried by a Block.
+
+    `members` is the FULL successor set (join = new key present, leave =
+    old key absent); stake and address ride along so quorum thresholds
+    and broadcast fan-out recompute from the change alone. Signed by a
+    current-epoch authority over a domain-separated digest."""
+
+    new_epoch: int
+    activation_round: Round
+    members: tuple[Member, ...]
+    author: PublicKey
+    signature: Signature
+
+    def digest(self) -> Digest:
+        h = b"HSEPOCH" + _member_bytes(self.new_epoch, self.activation_round, self.members)
+        return Digest(sha512_32(h + self.author.data))
+
+    def committee(self) -> Committee:
+        """The successor committee (quorum threshold recomputes from the
+        member stakes via Committee.quorum_threshold)."""
+        return Committee.new(list(self.members), epoch=self.new_epoch)
+
+    @staticmethod
+    def new_from_seed(
+        new_epoch: int,
+        activation_round: Round,
+        members: Sequence[Member],
+        author: PublicKey,
+        seed: bytes,
+    ) -> "EpochChange":
+        """Construct + sign with a raw ed25519 seed (pysigner — the
+        dependency-free path chaos and tests use)."""
+        from ..crypto import pysigner
+
+        change = EpochChange(
+            new_epoch, activation_round, tuple(members), author, Signature(bytes(64))
+        )
+        sig = Signature(pysigner.sign(seed, change.digest().data))
+        return EpochChange(new_epoch, activation_round, tuple(members), author, sig)
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.new_epoch)
+        w.u64(self.activation_round)
+        w.seq(
+            list(self.members),
+            lambda wr, m: (
+                wr.fixed(m[0].data, 32),
+                wr.u64(m[1]),
+                wr.var_bytes(m[2][0].encode()),
+                wr.u32(m[2][1]),
+            ),
+        )
+        w.fixed(self.author.data, 32)
+        w.fixed(self.signature.data, 64)
+
+    @staticmethod
+    def decode(r: Reader) -> "EpochChange":
+        new_epoch = r.u64()
+        activation_round = r.u64()
+        members = tuple(
+            r.seq(
+                lambda rd: (
+                    PublicKey(rd.fixed(32)),
+                    rd.u64(),
+                    (rd.var_bytes().decode(), rd.u32()),
+                )
+            )
+        )
+        return EpochChange(
+            new_epoch,
+            activation_round,
+            members,
+            PublicKey(r.fixed(32)),
+            Signature(r.fixed(64)),
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"EpochChange(epoch {self.new_epoch} @ round "
+            f"{self.activation_round}, {len(self.members)} validators)"
+        )
+
+
+def _member_bytes(epoch: int, activation: Round, members: tuple[Member, ...]) -> bytes:
+    w = Writer()
+    w.u64(epoch)
+    w.u64(activation)
+    for pk, stake, addr in members:
+        w.fixed(pk.data, 32)
+        w.u64(stake)
+        w.var_bytes(f"{addr[0]}:{addr[1]}".encode())
+    return w.bytes()
+
+
+class EpochSchedule:
+    """Pure round -> committee map: ordered (activation_round, committee)
+    entries, genesis at round 0. Appending is idempotent per epoch and
+    strictly sequenced (epoch e+1 only extends epoch e)."""
+
+    __slots__ = ("_entries",)
+
+    def __init__(self, genesis: Committee) -> None:
+        # (activation_round, committee, sorted keys) — keys cached: the
+        # leader elector resolves every round through this list.
+        self._entries: list[tuple[Round, Committee, list[PublicKey]]] = [
+            (0, genesis, genesis.sorted_keys())
+        ]
+
+    @property
+    def latest(self) -> Committee:
+        return self._entries[-1][1]
+
+    @property
+    def latest_epoch(self) -> int:
+        return self._entries[-1][1].epoch
+
+    def entries(self) -> list[tuple[Round, Committee]]:
+        return [(r, c) for r, c, _ in self._entries]
+
+    def committee_for_round(self, round_: Round) -> Committee:
+        for activation, committee, _keys in reversed(self._entries):
+            if round_ >= activation:
+                return committee
+        return self._entries[0][1]
+
+    def sorted_keys_for_round(self, round_: Round) -> list[PublicKey]:
+        for activation, _committee, keys in reversed(self._entries):
+            if round_ >= activation:
+                return keys
+        return self._entries[0][2]
+
+    def epoch_for_round(self, round_: Round) -> int:
+        return self.committee_for_round(round_).epoch
+
+    def apply(self, activation_round: Round, committee: Committee) -> bool:
+        """Append a boundary; False when already applied (idempotent) or
+        out of sequence (an epoch may only succeed its predecessor)."""
+        if committee.epoch != self.latest_epoch + 1:
+            return False
+        if activation_round <= self._entries[-1][0]:
+            return False
+        self._entries.append(
+            (activation_round, committee, committee.sorted_keys())
+        )
+        return True
+
+
+def as_manager(committee) -> "EpochManager":
+    """Accept a Committee or an EpochManager wherever consensus components
+    take one: a bare Committee wraps into a static single-epoch manager
+    (the pre-reconfig behaviour, and what most unit tests pass)."""
+    if isinstance(committee, EpochManager):
+        return committee
+    return EpochManager(committee)
+
+
+class EpochManager:
+    """A node's live epoch view: schedule + validation + apply-on-commit.
+
+    One instance is shared by the Core, LeaderElector, Aggregator and
+    Synchronizer of a node (consensus/consensus.py wires it), so a
+    committed epoch change atomically moves leader rotation, quorum
+    accounting and broadcast fan-out to the successor committee at the
+    activation boundary."""
+
+    def __init__(
+        self,
+        genesis: Committee,
+        on_switch: Callable[[Committee, Round], None] | None = None,
+        register_backend: bool = True,
+    ) -> None:
+        self.schedule = EpochSchedule(genesis)
+        self._on_switch = [on_switch] if on_switch is not None else []
+        self._register_backend = register_backend
+        self._round_hint: Round = 1  # newest round the core has reached
+
+    # -- resolution ---------------------------------------------------------
+
+    @property
+    def applied_epoch(self) -> int:
+        return self.schedule.latest_epoch
+
+    def committee_for_round(self, round_: Round) -> Committee:
+        return self.schedule.committee_for_round(round_)
+
+    def epoch_for_round(self, round_: Round) -> int:
+        return self.schedule.epoch_for_round(round_)
+
+    def current(self) -> Committee:
+        """The committee governing the newest round the core reported
+        (note_round) — what transmit paths broadcast against."""
+        return self.schedule.committee_for_round(self._round_hint)
+
+    def note_round(self, round_: Round) -> None:
+        if round_ > self._round_hint:
+            self._round_hint = round_
+
+    def address(self, name: PublicKey) -> Address | None:
+        """Resolve an authority address across every known epoch, newest
+        first — a boundary-round reply may target a peer that is only in
+        the adjacent epoch's committee."""
+        for _activation, committee in reversed(self.schedule.entries()):
+            addr = committee.address(name)
+            if addr is not None:
+                return addr
+        return None
+
+    def on_switch(self, hook: Callable[[Committee, Round], None]) -> None:
+        self._on_switch.append(hook)
+
+    # -- validation (proposal ingress) --------------------------------------
+
+    def validate(self, change: EpochChange, block_round: Round) -> None:
+        """Structural admission for an EpochChange riding a round-
+        `block_round` proposal; raises ReconfigError. The author's
+        signature is checked separately in Block.verify_async (it rides
+        the block's batched service group)."""
+        try:
+            ensure(
+                change.new_epoch == self.epoch_for_round(block_round) + 1,
+                ReconfigError(
+                    f"epoch change to {change.new_epoch} out of sequence "
+                    f"(round {block_round} is epoch "
+                    f"{self.epoch_for_round(block_round)})"
+                ),
+            )
+            ensure(
+                change.activation_round >= block_round + MIN_ACTIVATION_MARGIN,
+                ReconfigError(
+                    f"activation round {change.activation_round} inside the "
+                    f"commit margin of round {block_round}"
+                ),
+            )
+            ensure(
+                len(change.members) > 0,
+                ReconfigError("epoch change with an empty committee"),
+            )
+            committee = change.committee()
+            ensure(
+                committee.total_votes() > 0,
+                ReconfigError("epoch change with zero total stake"),
+            )
+        except ReconfigError:
+            _M_REJECTED.inc()
+            raise
+
+    # -- apply-on-commit -----------------------------------------------------
+
+    async def apply(
+        self, change: EpochChange, store=None, trigger_round: Round | None = None
+    ) -> bool:
+        """Epoch-commit rule: called only once the carrying block is
+        2-chain committed. Idempotent (a change committed in two blocks,
+        or re-applied from persistence, is a no-op the second time).
+
+        The boundary is ALWAYS the DECLARED activation round — pure
+        chain content, so every node (live, restarting, or replaying a
+        range-synced chain) derives the identical round -> committee
+        map. The block that locally completes the carrier's 2-chain is
+        deliberately NOT folded in: two nodes can first see different
+        QC-carrying envelopes (one of which may never certify), so any
+        trigger-derived boundary would diverge across honest nodes — a
+        schedule split, the one thing the epoch-commit rule exists to
+        prevent.
+
+        The margin contract is what keeps the declared round sound: the
+        commit normally lands well before the boundary (activation must
+        trail the carrier by MIN_ACTIVATION_MARGIN; proposers should
+        size the real margin against worst-case consecutive round
+        failures — the chaos directive uses 10). If the commit is
+        nevertheless delayed past the boundary (>= margin-2 consecutive
+        failed rounds inside the window), certificates formed in the
+        gap were certified by the old committee but are judged by the
+        new one — `trigger_round` (the caller's local commit position)
+        makes that pathology loudly observable (`reconfig.late_applies`)
+        instead of silent. ROADMAP item 5 records it as an open
+        residue."""
+        committee = change.committee()
+        if not self.schedule.apply(change.activation_round, committee):
+            return False
+        if (
+            trigger_round is not None
+            and trigger_round >= change.activation_round
+        ):
+            _M_LATE_APPLIES.inc()
+            log.warning(
+                "epoch %s applied LATE: commit landed at round %s, past "
+                "the declared activation round %s — certificates in the "
+                "gap were formed under the old committee (size the "
+                "activation margin against consecutive round failures)",
+                committee.epoch,
+                trigger_round,
+                change.activation_round,
+            )
+        self._switched(committee, change.activation_round)
+        if store is not None:
+            await self.save(store)
+        return True
+
+    def _switched(self, committee: Committee, activation_round: Round) -> None:
+        _M_SWITCHES.inc()
+        _M_EPOCH.set(committee.epoch)
+        # NOTE: this log entry is parsed by the benchmark LogParser.
+        log.info(
+            "Epoch switch to %s at activation round %s (%s validators, quorum %s)",
+            committee.epoch,
+            activation_round,
+            committee.size(),
+            committee.quorum_threshold(),
+        )
+        self._reregister(committee)
+        for hook in self._on_switch:
+            hook(committee, activation_round)
+
+    def _reregister(self, committee: Committee) -> None:
+        """Device-side committee succession: swap the backend's resident
+        key tables to the new epoch. TpuBackend registration is snapshot-
+        pinned (ops/ed25519.CommitteeTable): batches staged against the
+        old table finish on the OLD epoch's replicas while new traffic
+        resolves against the new indices — no flush barrier needed."""
+        if not self._register_backend:
+            return
+        from ..crypto import get_backend
+
+        backend = get_backend()
+        if hasattr(backend, "register_committee"):
+            try:
+                backend.register_committee(committee.sorted_keys())
+            except Exception as e:  # registration is an optimization only
+                log.warning("epoch committee re-registration failed: %r", e)
+
+    # -- persistence ---------------------------------------------------------
+
+    async def save(self, store) -> None:
+        entries = [
+            {"activation_round": r, "committee": c.to_json()}
+            for r, c in self.schedule.entries()[1:]  # genesis comes from config
+        ]
+        await store.write(_STORE_KEY, json.dumps(entries).encode())
+
+    async def load(self, store) -> None:
+        """Rebuild applied boundaries after a restart (idempotent). The
+        switch hooks re-fire so the backend tables match the persisted
+        epoch before the node rejoins."""
+        raw = await store.read(_STORE_KEY)
+        if raw is None:
+            return
+        for entry in json.loads(raw.decode()):
+            committee = Committee.from_json(entry["committee"])
+            if self.schedule.apply(entry["activation_round"], committee):
+                self._switched(committee, entry["activation_round"])

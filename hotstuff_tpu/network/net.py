@@ -16,6 +16,13 @@ per-peer per-LANE FIFO (one ordered TCP stream; urgent recovery traffic
 may overtake bulk gossip — see NetSender), at-most-once, NO delivery
 guarantee. This is the control plane and deliberately stays on host
 CPU/TCP; ICI collectives appear only inside the TPU crypto step.
+
+Urgent-lane users (NetMessage.urgent=True): mempool payload sync
+requests/replies, and the consensus synchronizer's recovery traffic —
+per-digest SyncRequests, batched catch-up SyncRangeRequest/Reply
+(consensus/messages.py) and the blocks served for them. Recovery frames
+un-stall consensus; queueing them behind megabytes of bulk gossip is
+exactly the stall they exist to clear.
 """
 
 from __future__ import annotations

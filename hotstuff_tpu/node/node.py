@@ -40,6 +40,12 @@ class Node:
         # telemetry plane (node run --telemetry-port) reads its LaneStats
         # for the per-lane SLO evaluation.
         self.verification_service = None
+        # The node's epoch view (consensus/reconfig.py): committed
+        # committee changes apply here, re-registering the device-resident
+        # committee tables at every switch (register_backend=True).
+        from ..consensus.reconfig import EpochManager
+
+        self.epoch_manager = EpochManager(self.committee.consensus)
 
     def boot(self) -> None:
         """Must run inside an event loop (actors spawn on construction)."""
@@ -78,6 +84,7 @@ class Node:
             self.commit_channel,
             core_channel=consensus_core_channel,
             verification_service=verification_service,
+            epoch_manager=self.epoch_manager,
         )
         log.info("Node %s successfully booted", name.short())
 

@@ -561,6 +561,20 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("consensus.sync_requests", "counter", None),
     ("consensus.sync_retries", "counter", None),
     ("consensus.sync_requests_served", "counter", None),
+    ("consensus.sync_abandoned", "counter", None),
+    ("consensus.sync_escalations", "counter", None),
+    # consensus/synchronizer.py + core.py — batched catch-up range sync
+    ("sync.range_requests", "counter", None),
+    ("sync.range_served", "counter", None),
+    ("sync.range_replies", "counter", None),
+    ("sync.range_blocks", "counter", None),
+    ("sync.parked_blocks", "counter", None),
+    # consensus/reconfig.py — dynamic validator reconfiguration
+    ("reconfig.epoch_switches", "counter", None),
+    ("reconfig.proposed", "counter", None),
+    ("reconfig.rejected", "counter", None),
+    ("reconfig.late_applies", "counter", None),
+    ("reconfig.epoch", "gauge", None),
     ("consensus.round", "gauge", None),
     ("consensus.proposal_to_vote_s", "histogram", None),
     ("consensus.qc_form_s", "histogram", None),
@@ -616,6 +630,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("chaos.withheld_votes", "counter", None),
     ("chaos.crashes", "counter", None),
     ("chaos.restarts", "counter", None),
+    ("chaos.late_boots", "counter", None),
     ("chaos.invariant_checks", "counter", None),
     ("chaos.invariant_violations", "counter", None),
     # utils/tracing.py — causal tracing + flight recorder

@@ -127,6 +127,46 @@ class TestCommitteeKernel:
         committee = verifier.verify_batch_mask_committee(msgs, idx, sigs)
         assert committee.tolist() == [True] * 4 + [False] * 4
 
+    def test_epoch_reregistration_pins_in_flight_snapshot(self, verifier):
+        """The epoch-reconfig contract on a single chip (the mesh variant
+        lives in tests/test_mesh_committee.py): a batch staged against a
+        pinned table snapshot completes correctly on the OLD epoch's
+        precompute even after a committee succession (one validator
+        leaves) re-registers the tables mid-flight — what
+        reconfig.EpochManager relies on when it swaps committees at a
+        committed boundary with chunks still in the dispatch window."""
+        msgs, pks, sigs = _vector_batch()
+        want = [True] * 4 + [False] * 4
+        keys = sorted(set(pks))
+        t1 = verifier.set_committee(keys)
+        idx_old = [t1.index[k] for k in pks]
+        # epoch succession: the last validator departs; indices permute
+        # and the departed key's precompute rows are gone from t2
+        departed = keys[-1]
+        t2 = verifier.set_committee(list(reversed(keys[:-1])))
+        assert t2 is not t1 and verifier.committee is t2
+        assert t2.size == t1.size - 1 and departed not in t2.index
+        # the in-flight old-epoch batch, pinned to t1, still verifies
+        # byte-identically (nothing swapped underneath it)
+        got = verifier.verify_batch_mask_committee(
+            msgs, idx_old, sigs, table=t1
+        )
+        assert got.tolist() == want
+        # new-epoch traffic: the surviving keys' lanes resolve against
+        # t2's fresh indices and keep their expected verdicts
+        live = [
+            (m, k, s, w)
+            for m, k, s, w in zip(msgs, pks, sigs, want)
+            if k != departed
+        ]
+        assert live
+        got2 = verifier.verify_batch_mask_committee(
+            [m for m, _k, _s, _w in live],
+            [t2.index[k] for _m, k, _s, _w in live],
+            [s for _m, _k, s, _w in live],
+        )
+        assert got2.tolist() == [w for _m, _k, _s, w in live]
+
 
 class TestBackendRouting:
     def test_tagged_batches_ride_committee_kernel(self):

@@ -982,3 +982,80 @@ def test_log_parser_scrapes_telemetry_lines():
     assert "Worst-node device occupancy: 44.8 %" in out
     assert "overlap headroom 71.5 %" in out
     assert "SLO burn alerts: 1 fired (lane.mempool), 1 cleared" in out
+
+
+# ---------------------------------------------------------------------------
+# Scenario-registry lint (tools/lint_metrics.py lint_scenarios) + the
+# LogParser RECONFIG section (benchmark/logs.py)
+
+
+def _load_lint():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("lint_metrics", _LINT)
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+    return lint
+
+
+def test_lint_scenarios_clean_on_repo():
+    assert _load_lint().lint_scenarios() == []
+
+
+def test_lint_scenarios_flags_expectationless_and_unrun(monkeypatch, tmp_path):
+    """An expectation-less scenario and a slow scenario named in no test
+    module are both rc-1 violations (an 'unregistered' scenario silently
+    never runs; an expect-less one passes while its fault stops firing)."""
+    from hotstuff_tpu.chaos import scenarios as sc
+
+    lint = _load_lint()
+    rogue = sc.Scenario(
+        name="ghost_soak",
+        description="registered but never run",
+        slow=True,
+        expect=None,
+    )
+    monkeypatch.setitem(sc.SCENARIOS, "ghost_soak", rogue)
+    # lint_scenarios imports hotstuff_tpu.chaos.scenarios in-process, so
+    # the monkeypatched registry is visible; scan an EMPTY tests dir so
+    # this very file's string literals don't count as coverage.
+    problems = lint.lint_scenarios(tests_dir=str(tmp_path))
+    mine = [p for p in problems if "ghost_soak" in p]
+    assert len(mine) == 2
+    assert any("expectation" in p for p in mine)
+    assert any("nothing ever runs it" in p for p in mine)
+
+
+def test_log_parser_reconfig_section():
+    """Epoch-switch and range-sync log lines fold into a '+ RECONFIG:'
+    section: switch count with the highest epoch/activation round, and
+    catch-up range syncs with the worst start lag + blocks fetched."""
+    from benchmark.logs import LogParser
+
+    assert "+ RECONFIG" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node = NODE_LOG + (
+        "[2026-07-30T10:00:03.000Z INFO hotstuff.consensus] Epoch switch "
+        "to 2 at activation round 15 (4 validators, quorum 3)\n"
+        "[2026-07-30T10:00:05.000Z INFO hotstuff.consensus] Range sync "
+        "started for KLeV1S+p: 9 rounds behind\n"
+        "[2026-07-30T10:00:05.400Z INFO hotstuff.consensus] Range sync "
+        "fetched 4 blocks\n"
+        "[2026-07-30T10:00:05.800Z INFO hotstuff.consensus] Range sync "
+        "fetched 3 blocks\n"
+    )
+    other = NODE_LOG + (
+        "[2026-07-30T10:00:03.100Z INFO hotstuff.consensus] Epoch switch "
+        "to 2 at activation round 15 (4 validators, quorum 3)\n"
+        "[2026-07-30T10:00:06.000Z INFO hotstuff.consensus] Range sync "
+        "started for sIm244D/: 21 rounds behind\n"
+        "[2026-07-30T10:00:06.500Z INFO hotstuff.consensus] Range sync "
+        "fetched 12 blocks\n"
+    )
+    p = LogParser([CLIENT_LOG], [node, other])
+    assert p.epoch_switches == [(2, 15), (2, 15)]
+    assert sorted(p.range_lags) == [9, 21]
+    assert p.range_blocks == 19
+    out = p.result()
+    assert "+ RECONFIG:" in out
+    assert "Epoch switches observed: 2 (highest epoch 2 at round 15)" in out
+    assert "2 range sync(s), worst start lag 21 rounds, 19 blocks fetched" in out

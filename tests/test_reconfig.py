@@ -1,0 +1,353 @@
+"""Epoch reconfiguration units (consensus/reconfig.py) — dependency-free
+(pysigner signs, no `cryptography`, no jax): EpochChange wire + digest
+binding, the EpochSchedule round->committee map, EpochManager
+validation/apply/persistence, the Committee epoch plumbing (JSON
+round-trip, unequal-stake quorum), and the epoch-aware leader elector /
+aggregator behaviour on both sides of a boundary.
+"""
+
+import pytest
+
+from hotstuff_tpu.consensus.config import Authority, Committee
+from hotstuff_tpu.consensus.errors import (
+    ReconfigError,
+    UnknownAuthorityError,
+)
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.messages import QC, Block, Vote, _vote_digest
+from hotstuff_tpu.consensus.reconfig import (
+    MIN_ACTIVATION_MARGIN,
+    EpochChange,
+    EpochManager,
+    EpochSchedule,
+    as_manager,
+)
+from hotstuff_tpu.crypto import pysigner
+from hotstuff_tpu.crypto.primitives import Digest, PublicKey, Signature
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.serde import Reader, Writer
+
+
+def _keys(n: int = 5):
+    pairs = sorted(
+        pysigner.keypair_from_seed(bytes([i + 1]) * 32) for i in range(n)
+    )
+    return [(PublicKey(pk), seed) for pk, seed in pairs]
+
+
+def _committee(keys, indices, epoch: int = 1, stakes=None) -> Committee:
+    return Committee.new(
+        [
+            (keys[i][0], (stakes or {}).get(i, 1), ("127.0.0.1", 9_000 + i))
+            for i in indices
+        ],
+        epoch=epoch,
+    )
+
+
+def _change(keys, indices, new_epoch=2, activation=20, signer=0) -> EpochChange:
+    members = [
+        (keys[i][0], 1, ("127.0.0.1", 9_000 + i)) for i in indices
+    ]
+    pk, seed = keys[signer]
+    return EpochChange.new_from_seed(new_epoch, activation, members, pk, seed)
+
+
+# --- Committee epoch plumbing (satellite) -----------------------------------
+
+
+def test_committee_json_round_trips_epoch():
+    keys = _keys()
+    cmt = _committee(keys, [0, 1, 2, 3], epoch=7)
+    again = Committee.from_json(cmt.to_json())
+    assert again.epoch == 7
+    assert again.sorted_keys() == cmt.sorted_keys()
+    assert again.quorum_threshold() == cmt.quorum_threshold()
+    assert all(
+        again.address(pk) == cmt.address(pk) for pk in cmt.sorted_keys()
+    )
+    # absent epoch defaults to 1 (pre-reconfig committee files)
+    obj = cmt.to_json()
+    del obj["epoch"]
+    assert Committee.from_json(obj).epoch == 1
+
+
+def test_quorum_threshold_unequal_stake():
+    keys = _keys()
+    # stakes 1+1+2+6 = 10 -> threshold 2*10//3 + 1 = 7: the heavy
+    # authority alone is below quorum, heavy + mid reaches only 8 >= 7
+    cmt = _committee(keys, [0, 1, 2, 3], stakes={0: 1, 1: 1, 2: 2, 3: 6})
+    assert cmt.total_votes() == 10
+    assert cmt.quorum_threshold() == 7
+    heavy = cmt.sorted_keys()[3]
+    assert cmt.stake(keys[3][0]) == 6 < cmt.quorum_threshold()
+    # succession recomputes the threshold from the NEW stakes
+    change = _change(keys, [0, 1, 2], activation=30)
+    successor = change.committee()
+    assert successor.epoch == 2
+    assert successor.total_votes() == 3
+    assert successor.quorum_threshold() == 3
+
+
+# --- EpochChange wire + digest binding --------------------------------------
+
+
+def test_epoch_change_encode_decode_and_signature():
+    keys = _keys()
+    change = _change(keys, [0, 1, 2, 4])
+    w = Writer()
+    change.encode(w)
+    again = EpochChange.decode(Reader(w.bytes()))
+    assert again == change
+    assert pysigner.verify(
+        change.author.data, change.digest().data, change.signature.data
+    )
+    # the digest commits to every field
+    tampered = EpochChange(
+        change.new_epoch,
+        change.activation_round + 1,
+        change.members,
+        change.author,
+        change.signature,
+    )
+    assert tampered.digest() != change.digest()
+
+
+def test_block_digest_commits_to_reconfig():
+    keys = _keys()
+    change = _change(keys, [0, 1, 2, 4])
+    author = keys[0][0]
+    plain = Block(QC.genesis(), None, author, 3, (), Signature(bytes(64)))
+    carrying = Block(
+        QC.genesis(), None, author, 3, (), Signature(bytes(64)), change
+    )
+    # stripping or altering the carried change breaks the block digest
+    assert carrying.digest() != plain.digest()
+    other = _change(keys, [0, 1, 2], activation=25)
+    assert (
+        Block(QC.genesis(), None, author, 3, (), Signature(bytes(64)), other)
+        .digest()
+        != carrying.digest()
+    )
+    # reconfig-free digest preimage is unchanged vs the historical format
+    assert plain.digest() == Block.make_digest(author, 3, [], QC.genesis())
+
+
+# --- EpochSchedule ----------------------------------------------------------
+
+
+def test_schedule_resolves_rounds_across_boundary():
+    keys = _keys()
+    genesis = _committee(keys, [0, 1, 2, 3])
+    sched = EpochSchedule(genesis)
+    e2 = _committee(keys, [0, 1, 2, 4], epoch=2)
+    assert sched.apply(15, e2)
+    for r in (0, 1, 14):
+        assert sched.committee_for_round(r) is genesis
+        assert sched.epoch_for_round(r) == 1
+    for r in (15, 16, 1_000):
+        assert sched.committee_for_round(r) is e2
+        assert sched.epoch_for_round(r) == 2
+    # idempotent + strictly sequenced
+    assert not sched.apply(15, e2)  # same epoch again
+    e4 = _committee(keys, [0, 1], epoch=4)
+    assert not sched.apply(30, e4)  # skips epoch 3
+    e3 = _committee(keys, [0, 1, 2], epoch=3)
+    assert not sched.apply(10, e3)  # boundary not past the previous one
+    assert sched.apply(40, e3)
+    assert sched.epoch_for_round(40) == 3
+
+
+# --- EpochManager -----------------------------------------------------------
+
+
+def test_manager_validate_rejects_bad_changes():
+    keys = _keys()
+    mgr = as_manager(_committee(keys, [0, 1, 2, 3]))
+    ok = _change(keys, [0, 1, 2, 4], activation=10 + MIN_ACTIVATION_MARGIN)
+    mgr.validate(ok, block_round=10)  # no raise
+    with pytest.raises(ReconfigError):
+        mgr.validate(_change(keys, [0, 1], new_epoch=3), block_round=10)
+    with pytest.raises(ReconfigError):  # boundary inside the commit margin
+        mgr.validate(
+            _change(keys, [0, 1], activation=10 + MIN_ACTIVATION_MARGIN - 1),
+            block_round=10,
+        )
+    with pytest.raises(ReconfigError):  # empty successor set
+        mgr.validate(_change(keys, [], activation=40), block_round=10)
+
+
+def test_manager_apply_switch_hooks_and_address_resolution(run_async):
+    async def body():
+        keys = _keys()
+        genesis = _committee(keys, [0, 1, 2, 3])
+        seen = []
+        mgr = EpochManager(
+            genesis,
+            on_switch=lambda c, act: seen.append((c.epoch, act)),
+            register_backend=False,
+        )
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert await mgr.apply(change)
+        assert not await mgr.apply(change)  # idempotent
+        assert seen == [(2, 15)]
+        assert mgr.applied_epoch == 2
+        # current() follows the round hint across the boundary
+        mgr.note_round(10)
+        assert mgr.current().epoch == 1
+        mgr.note_round(15)
+        assert mgr.current().epoch == 2
+        # address resolution spans epochs, newest first: the departed
+        # node 3 (epoch 1 only) and the joined node 4 (epoch 2 only)
+        assert mgr.address(keys[3][0]) == ("127.0.0.1", 9_003)
+        assert mgr.address(keys[4][0]) == ("127.0.0.1", 9_004)
+
+    run_async(body())
+
+
+def test_manager_persistence_round_trip(run_async):
+    async def body():
+        keys = _keys()
+        genesis = _committee(keys, [0, 1, 2, 3])
+        store = Store()
+        mgr = EpochManager(genesis, register_backend=False)
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert await mgr.apply(change, store=store)
+        # a fresh incarnation (restart) rebuilds the identical mapping
+        seen = []
+        again = EpochManager(
+            genesis,
+            on_switch=lambda c, act: seen.append((c.epoch, act)),
+            register_backend=False,
+        )
+        await again.load(store)
+        assert again.applied_epoch == 2
+        assert seen == [(2, 15)]  # hooks re-fire on reload (backend tables)
+        assert again.committee_for_round(15).sorted_keys() == sorted(
+            keys[i][0] for i in (0, 1, 2, 4)
+        )
+        # reload is idempotent
+        await again.load(store)
+        assert again.applied_epoch == 2 and len(seen) == 1
+
+    run_async(body())
+
+
+# --- epoch-aware election + aggregation -------------------------------------
+
+
+def test_leader_rotation_crosses_the_boundary():
+    keys = _keys()
+    genesis = _committee(keys, [0, 1, 2, 3])
+    mgr = EpochManager(genesis, register_backend=False)
+    sched_keys_1 = genesis.sorted_keys()
+    elector = LeaderElector(mgr)
+    assert elector.get_leader(14) == sched_keys_1[14 % 4]
+    mgr.schedule.apply(15, _committee(keys, [0, 1, 2, 4], epoch=2))
+    new_keys = sorted(keys[i][0] for i in (0, 1, 2, 4))
+    # pre-boundary rounds keep the old rotation, post-boundary the new:
+    # the departed key never leads again, the joined one enters
+    assert elector.get_leader(14) == sched_keys_1[14 % 4]
+    for r in range(15, 23):
+        assert elector.get_leader(r) == new_keys[r % 4]
+    assert keys[3][0] not in {elector.get_leader(r) for r in range(15, 40)}
+    assert keys[4][0] in {elector.get_leader(r) for r in range(15, 40)}
+
+
+def test_aggregator_counts_votes_per_epoch():
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+
+    keys = _keys()
+    genesis = _committee(keys, [0, 1, 2, 3])
+    mgr = EpochManager(genesis, register_backend=False)
+    mgr.schedule.apply(15, _committee(keys, [0, 1, 2, 4], epoch=2))
+    agg = Aggregator(mgr)
+
+    def vote(i, round_):
+        digest = Digest(bytes([round_]) * 32)
+        return Vote(
+            digest,
+            round_,
+            keys[i][0],
+            Signature(
+                pysigner.sign(keys[i][1], _vote_digest(digest, round_).data)
+            ),
+        )
+
+    # pre-boundary: the old committee's members aggregate, the joiner is
+    # unknown stake
+    assert agg.add_vote(vote(0, 10)) is None
+    with pytest.raises(UnknownAuthorityError):
+        agg.add_vote(vote(4, 10))
+    assert agg.add_vote(vote(1, 10)) is None
+    qc = agg.add_vote(vote(3, 10))
+    assert qc is not None and qc.round == 10
+    # post-boundary: the joiner counts, the departed member is unknown
+    assert agg.add_vote(vote(0, 16)) is None
+    with pytest.raises(UnknownAuthorityError):
+        agg.add_vote(vote(3, 16))
+    assert agg.add_vote(vote(1, 16)) is None
+    qc2 = agg.add_vote(vote(4, 16))
+    assert qc2 is not None and qc2.round == 16
+    # the boundary-crossing QCs verify against their OWN epochs through
+    # the schedule resolver (per-epoch check_quorum)
+    qc.check_quorum(mgr)
+    qc2.check_quorum(mgr)
+
+
+def test_boundary_is_the_declared_round_and_late_applies_are_loud(run_async):
+    """The boundary is ALWAYS the declared activation round (pure chain
+    content — a commit-position-derived boundary would diverge across
+    nodes that first see different QC-carrying envelopes). A commit that
+    lands past the boundary is the documented margin-violation pathology
+    and must be OBSERVABLE (reconfig.late_applies), never silent."""
+    from hotstuff_tpu.utils import metrics
+
+    late_applies = metrics.counter("reconfig.late_applies")
+
+    async def body():
+        keys = _keys()
+        genesis = _committee(keys, [0, 1, 2, 3])
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        # timely commit (trigger below the boundary): no late-apply signal
+        mgr = EpochManager(genesis, register_backend=False)
+        c0 = late_applies.value
+        assert await mgr.apply(change, trigger_round=14)
+        assert late_applies.value == c0
+        assert mgr.committee_for_round(14).epoch == 1
+        assert mgr.committee_for_round(15).epoch == 2
+        # delayed commit: boundary STAYS at the declared round on every
+        # node (determinism first), and the pathology is counted
+        late = EpochManager(genesis, register_backend=False)
+        assert await late.apply(change, trigger_round=20)
+        assert late_applies.value == c0 + 1
+        assert late.committee_for_round(15).epoch == 2
+        assert (
+            late.schedule.entries() == mgr.schedule.entries()
+        ), "late and timely appliers must derive the identical schedule"
+
+    run_async(body())
+
+
+def test_safety_checker_boundary_matches_the_nodes():
+    """The chaos SafetyChecker schedules the boundary exactly where the
+    nodes do — the declared activation round — so committed QCs on both
+    sides are judged against the same per-epoch committees."""
+    from hotstuff_tpu.chaos.invariants import SafetyChecker
+
+    keys = _keys()
+    genesis = _committee(keys, [0, 1, 2, 3])
+    checker = SafetyChecker(genesis)
+    change = _change(keys, [0, 1, 2, 4], activation=12, signer=0)
+    author = keys[0][0]
+
+    def commit(round_, reconfig=None, qc=QC.genesis()):
+        checker.on_commit(
+            0, Block(qc, None, author, round_, (), Signature(bytes(64)), reconfig)
+        )
+
+    commit(9, reconfig=change)  # carrier: schedules at the declared round
+    assert checker.schedule.latest_epoch == 2
+    assert checker.schedule.committee_for_round(11).epoch == 1
+    assert checker.schedule.committee_for_round(12).epoch == 2
+    assert not [v for v in checker.violations if "EpochChange" in v]

@@ -45,6 +45,16 @@ DeviceTimeline's known phases (`timeline.PHASES`) — a renamed stage
 would silently fall out of the occupancy/headroom math and out of
 trace_report.py's device rows.
 
+The scenario-registry lint (chaos/scenarios.py) fails rc 1 when:
+
+  * a registered chaos scenario has no `expect` — every scenario must
+    assert something beyond not-crashing, or it degenerates into a
+    smoke test that passes while the fault it models stops firing; or
+  * a scenario appears in NO test matrix: non-slow scenarios are swept
+    by tests/test_chaos.py's SHORT_SCENARIOS parametrization by
+    construction, but a `slow=True` scenario must be named (string
+    literal) somewhere under tests/ or nothing ever runs it.
+
 `utils/telemetry.py`, `ops/timeline.py` and `ops/pipeline.py` must stay
 importable without jax (like DeviceScheduler) — this lint runs on
 jax-less hosts.
@@ -190,6 +200,38 @@ def lint_pipeline() -> list[str]:
     ]
 
 
+def lint_scenarios(tests_dir: str | None = None) -> list[str]:
+    """Every chaos scenario must carry an expectation and be runnable by
+    some test tier (see module docstring). Imports jax-free — the chaos
+    plane runs on pysigner by design."""
+    from hotstuff_tpu.chaos.scenarios import SCENARIOS, SHORT_SCENARIOS
+
+    if tests_dir is None:
+        tests_dir = os.path.join(os.path.dirname(__file__), "..", "tests")
+    corpus = ""
+    if os.path.isdir(tests_dir):
+        for fn in sorted(os.listdir(tests_dir)):
+            if fn.endswith(".py"):
+                with open(os.path.join(tests_dir, fn), encoding="utf-8") as f:
+                    corpus += f.read()
+    problems: list[str] = []
+    for name, scenario in sorted(SCENARIOS.items()):
+        if scenario.expect is None:
+            problems.append(
+                f"chaos scenario {name!r} has no expectation — it would "
+                "pass even when the fault it models stops firing; add an "
+                "expect="
+            )
+        quoted = f'"{name}"' in corpus or f"'{name}'" in corpus
+        if name not in SHORT_SCENARIOS and not quoted:
+            problems.append(
+                f"chaos scenario {name!r} is outside the tier-1 sweep "
+                "(slow) and named in no tests/ module — nothing ever "
+                "runs it"
+            )
+    return problems
+
+
 def run(root: str) -> list[str]:
     from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
     from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
@@ -208,7 +250,13 @@ def run(root: str) -> list[str]:
                 EVENT_KINDS,
                 set(SOURCE_CLASSES),
             )
-    return problems + lint_scheduler() + lint_telemetry() + lint_pipeline()
+    return (
+        problems
+        + lint_scheduler()
+        + lint_telemetry()
+        + lint_pipeline()
+        + lint_scenarios()
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
