@@ -169,6 +169,24 @@ def _parse_node(text: str) -> dict:
     out["range_blocks"] = sum(
         int(n) for n in _search_all(r"Range sync fetched (\d+) blocks", text)
     )
+    # Scenario-matrix result lines (tools/chaos_run.py --matrix): per-cell
+    # verdicts, green->red regressions against the committed baseline
+    # artifact, and the worst per-cell commit-rate delta.
+    out["matrix_cells"] = [
+        (cell, verdict)
+        for cell, verdict in _search_all(
+            r"MATRIX cell (\S+) (green|red) ", text
+        )
+    ]
+    out["matrix_regressions"] = _search_all(
+        r"MATRIX regression: (\S+) went red", text
+    )
+    out["matrix_worst"] = [
+        (cell, float(pct))
+        for cell, pct in _search_all(
+            r"MATRIX worst regression: (\S+) commit rate ([+-]?[\d.]+)%", text
+        )
+    ]
     occ = _search_all(
         r"TELEMETRY device occupancy ([\d.]+)% overlap headroom ([\d.]+)%",
         text,
@@ -268,6 +286,11 @@ class LogParser:
         self.epoch_switches: list[tuple[int, int]] = []
         self.range_lags: list[int] = []
         self.range_blocks = 0
+        # Scenario-matrix lines: (cell, green|red) verdicts, newly-red
+        # cell names, and (cell, pct) worst commit-rate deltas.
+        self.matrix_cells: list[tuple[str, str]] = []
+        self.matrix_regressions: list[str] = []
+        self.matrix_worst: list[tuple[str, float]] = []
         # (occupancy %, overlap headroom %) per node that logged telemetry
         self.occupancies: list[tuple[float, float]] = []
         # Final METRICS snapshot per node (utils/metrics.py), and the
@@ -297,6 +320,9 @@ class LogParser:
             self.epoch_switches.extend(r.get("epoch_switches", []))
             self.range_lags.extend(r.get("range_lags", []))
             self.range_blocks += r.get("range_blocks", 0)
+            self.matrix_cells.extend(r.get("matrix_cells", []))
+            self.matrix_regressions.extend(r.get("matrix_regressions", []))
+            self.matrix_worst.extend(r.get("matrix_worst", []))
             if r.get("occupancy") is not None:
                 self.occupancies.append(r["occupancy"])
             if r.get("metrics") is not None:
@@ -498,6 +524,27 @@ class LogParser:
                     f" SLO burn alerts: {len(self.slo_fired)} fired"
                     f" ({names}), {len(self.slo_cleared)} cleared\n"
                 )
+        matrix = ""
+        if self.matrix_cells:
+            greens = sum(1 for _c, v in self.matrix_cells if v == "green")
+            reds = len(self.matrix_cells) - greens
+            matrix = (
+                " + MATRIX:\n"
+                f" Cells: {len(self.matrix_cells)} run"
+                f" ({greens} green, {reds} red)\n"
+            )
+            if self.matrix_regressions:
+                names = ", ".join(sorted(set(self.matrix_regressions)))
+                matrix += (
+                    f" REGRESSION: {len(self.matrix_regressions)} previously-"
+                    f"green cell(s) went red: {names}\n"
+                )
+            if self.matrix_worst:
+                cell, pct = min(self.matrix_worst, key=lambda cw: cw[1])
+                matrix += (
+                    f" Worst commit-rate delta vs baseline: {cell}"
+                    f" {pct:+.2f} %\n"
+                )
         reconfig = ""
         if self.epoch_switches or self.range_lags:
             reconfig = " + RECONFIG:\n"
@@ -551,6 +598,7 @@ class LogParser:
             )
             + ingress
             + telemetry
+            + matrix
             + reconfig
             + mtr
             + "-----------------------------------------\n"

@@ -8,11 +8,14 @@ Entry points:
   * `tools/chaos_run.py` — the CLI wrapper (`--scenario`, `--seed`,
     `--report out.json`).
 
-Layering: plan.py (declarative fault schedules + seeded RNG streams) →
-transport.py (FaultyTransport at the NetSender/NetReceiver seam) →
-byzantine.py (adversary policies) → invariants.py (safety/liveness
-checkers) → orchestrator.py (node lifecycle, crash/restart) →
-scenarios.py (the library). vtime.py supplies the deterministic clock.
+Layering: plan.py (declarative fault schedules + seeded RNG streams +
+the WanMatrix per-region RTT classes) → transport.py (FaultyTransport
+at the NetSender/NetReceiver seam) → byzantine.py (adversary policies)
+→ invariants.py (safety/liveness checkers) → orchestrator.py (node
+lifecycle, crash/restart) → scenarios.py (the library + the
+scenario-matrix grid). vtime.py supplies the deterministic clock;
+trusted_crypto.py supplies the keyed-hash stub scheme that makes
+hundred-node fleets runnable on one box (see its trust model).
 """
 
 from .byzantine import (
@@ -24,9 +27,25 @@ from .byzantine import (
 )
 from .invariants import LivenessChecker, SafetyChecker
 from .orchestrator import ChaosOrchestrator, DeterministicMempool, ReconfigDirective
-from .plan import CrashWindow, DelayedBoot, FaultPlan, LinkFaults, Partition, SeededRng
-from .scenarios import SCENARIOS, SHORT_SCENARIOS, run_scenario
+from .plan import (
+    CrashWindow,
+    DelayedBoot,
+    FaultPlan,
+    LinkFaults,
+    Partition,
+    SeededRng,
+    WanMatrix,
+)
+from .scenarios import (
+    MATRIX_SCENARIOS,
+    MATRIX_SEEDS,
+    MATRIX_SIZES,
+    SCENARIOS,
+    SHORT_SCENARIOS,
+    run_scenario,
+)
 from .transport import FaultyTransport, NODE_LABEL
+from .trusted_crypto import TrustedCryptoScheme
 from .vtime import VirtualTimeLoop
 
 __all__ = [
@@ -40,6 +59,9 @@ __all__ = [
     "FaultyTransport",
     "LinkFaults",
     "LivenessChecker",
+    "MATRIX_SCENARIOS",
+    "MATRIX_SEEDS",
+    "MATRIX_SIZES",
     "NODE_LABEL",
     "Partition",
     "ReconfigDirective",
@@ -49,7 +71,9 @@ __all__ = [
     "SeededRng",
     "SigForger",
     "StaleReplayer",
+    "TrustedCryptoScheme",
     "VirtualTimeLoop",
     "VoteWithholder",
+    "WanMatrix",
     "run_scenario",
 ]

@@ -160,6 +160,7 @@ class ChaosOrchestrator:
         telemetry_config: "telemetry.TelemetryConfig | None" = None,
         committee_indices: list[int] | None = None,
         reconfig: ReconfigDirective | None = None,
+        trusted_crypto: bool = False,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -169,12 +170,23 @@ class ChaosOrchestrator:
         self.parameters = parameters or Parameters(
             timeout_delay=1_000, sync_retry_delay=1_000
         )
+        # Trusted-crypto mode (chaos/trusted_crypto.py): keyed-hash stub
+        # signatures behind the pysigner scheme seam, installed for the
+        # run's duration in run(). Keys must come from the SAME scheme the
+        # run will verify under, so derive them through the instance here.
+        self.crypto_scheme = None
+        if trusted_crypto:
+            from .trusted_crypto import TrustedCryptoScheme
+
+            self.crypto_scheme = TrustedCryptoScheme()
+        _keypair = (
+            self.crypto_scheme.keypair_from_seed
+            if self.crypto_scheme is not None
+            else pysigner.keypair_from_seed
+        )
 
         key_stream = self.rng.stream("keys")
-        pairs = [
-            pysigner.keypair_from_seed(key_stream.randbytes(32))
-            for _ in range(n)
-        ]
+        pairs = [_keypair(key_stream.randbytes(32)) for _ in range(n)]
         # Node index = sorted-key order, matching LeaderElector rotation.
         pairs.sort(key=lambda kp: kp[0])
         self.keys = [(PublicKey(pk), seed_) for pk, seed_ in pairs]
@@ -597,6 +609,11 @@ class ChaosOrchestrator:
         structured report."""
         prev_backend = set_backend(pysigner.PurePythonBackend())
         prev_transport = net.install_transport(self.transport)
+        # Scheme install covers EVERY pysigner path for the run — node
+        # signature services, backend verification, byzantine policies,
+        # EpochChange construction, the SafetyChecker audit — so a run is
+        # never half-stubbed (restored in the finally with the rest).
+        prev_scheme = pysigner.install_scheme(self.crypto_scheme)
         run_scope = SpawnScope("chaos-run")
         loop = asyncio.get_running_loop()
         # Flight-recorder events follow the VIRTUAL clock for this run, so
@@ -658,6 +675,7 @@ class ChaosOrchestrator:
                 await asyncio.gather(*stray, return_exceptions=True)
             net.install_transport(prev_transport)
             set_backend(prev_backend)
+            pysigner.install_scheme(prev_scheme)
             for plane in self.telemetry_planes.values():
                 plane.detach_watchdog()
             tracing.WATCHDOG.remove_dump_hook(_capture)
@@ -678,6 +696,18 @@ class ChaosOrchestrator:
             "nodes": self.n,
             "byzantine": sorted(self.byzantine),
             "virtual_seconds": round(elapsed, 6),
+            # Which signature scheme the run executed under (see
+            # chaos/trusted_crypto.py for the stub's trust model) and the
+            # seed-derived WAN region per node (empty without a matrix).
+            "crypto_mode": (
+                self.crypto_scheme.name
+                if self.crypto_scheme is not None
+                else "exact"
+            ),
+            "wan_regions": {
+                str(i): region
+                for i, region in enumerate(self.transport.regions)
+            },
             "plan": self.plan.to_json(),
             "events": self.events,
             "commits": {
@@ -737,6 +767,9 @@ class ChaosOrchestrator:
             },
             "fault_trace": self.transport.trace,
             "fault_trace_overflow": self.transport.trace_overflow,
+            # Explicit truncation flag (plus the chaos.fault_trace_dropped
+            # counter): a capped trace must never read as a complete one.
+            "fault_trace_truncated": self.transport.trace_overflow > 0,
             "safety_violations": self.safety.violations,
             "liveness_violations": self.liveness.violations,
             # Per-node flight-recorder dumps (one shared virtual-clock

@@ -50,6 +50,74 @@ class LinkFaults:
         )
 
 
+# Default inter-region ROUND-TRIP times (ms), loosely the public-cloud
+# numbers Handel-style evaluations assume (PAPERS.md, arXiv:1906.05132
+# runs city-to-city WAN topologies): two US regions, one EU, one AP.
+# One-way link latency = rtt/2; same-region traffic pays `intra_rtt_ms`.
+_DEFAULT_REGIONS = ("us-east", "us-west", "eu-west", "ap-north")
+_DEFAULT_RTT_MS = (
+    ("us-east", "us-west", 62.0),
+    ("us-east", "eu-west", 82.0),
+    ("us-east", "ap-north", 158.0),
+    ("us-west", "eu-west", 136.0),
+    ("us-west", "ap-north", 102.0),
+    ("eu-west", "ap-north", 224.0),
+)
+
+
+@dataclass(frozen=True)
+class WanMatrix:
+    """Per-region RTT classes for a fleet: each node is assigned a region
+    deterministically from the run's seed, and every directed link pays
+    the matrix's one-way latency for its (src-region, dst-region) pair in
+    ADDITION to the LinkFaults delay/jitter (faults model the link's
+    quality; the matrix models where the endpoints sit). A flat
+    `LinkFaults.delay` gives every pair the same cost — this is the
+    topology future aggregation overlays (ROADMAP item 2) have to win
+    on: an aggregation tree that respects regions beats one that does
+    not only if cross-region links actually cost more."""
+
+    regions: tuple[str, ...] = _DEFAULT_REGIONS
+    rtt_ms: tuple[tuple[str, str, float], ...] = _DEFAULT_RTT_MS
+    intra_rtt_ms: float = 4.0
+
+    def __post_init__(self) -> None:
+        table = {}
+        for a, b, rtt in self.rtt_ms:
+            table[(a, b)] = table[(b, a)] = rtt / 2e3  # one-way seconds
+        for r in self.regions:
+            table[(r, r)] = self.intra_rtt_ms / 2e3
+        missing = [
+            (a, b)
+            for a in self.regions
+            for b in self.regions
+            if (a, b) not in table
+        ]
+        if missing:
+            raise ValueError(f"WanMatrix missing RTT for region pairs {missing}")
+        object.__setattr__(self, "_one_way", table)
+
+    def one_way_s(self, src_region: str, dst_region: str) -> float:
+        return self._one_way[(src_region, dst_region)]
+
+    def assign(self, rng, n: int) -> list[str]:
+        """Region per node index, a pure function of the given seeded
+        stream: the region LIST is shuffled once, then nodes take regions
+        round-robin — balanced occupancy (every region within 1 of n/R)
+        with a seed-dependent mapping, so two seeds exercise different
+        leader-region geometries without ever emptying a region."""
+        order = list(self.regions)
+        rng.shuffle(order)
+        return [order[i % len(order)] for i in range(n)]
+
+    def to_json(self) -> dict:
+        return {
+            "regions": list(self.regions),
+            "rtt_ms": [list(row) for row in self.rtt_ms],
+            "intra_rtt_ms": self.intra_rtt_ms,
+        }
+
+
 @dataclass(frozen=True)
 class Partition:
     """Between virtual times [start, end), nodes in different groups cannot
@@ -107,6 +175,10 @@ class FaultPlan:
     partitions: list[Partition] = field(default_factory=list)
     crashes: list[CrashWindow] = field(default_factory=list)
     boots: list[DelayedBoot] = field(default_factory=list)
+    # Per-region WAN latency classes layered ON TOP of link faults (None =
+    # every link pays only its LinkFaults delay, the historical behaviour
+    # — committed scenario determinism pins rely on that default).
+    wan: WanMatrix | None = None
 
     def link(self, src: int, dst: int) -> LinkFaults:
         return self.links.get((src, dst), self.default_link)
@@ -129,4 +201,5 @@ class FaultPlan:
                 for c in self.crashes
             ],
             "boots": [{"node": b.node, "at": b.at} for b in self.boots],
+            "wan": self.wan.to_json() if self.wan is not None else None,
         }

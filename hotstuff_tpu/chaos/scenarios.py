@@ -749,9 +749,101 @@ _register(
 # The short sweep tier-1 runs (and the CLI's --scenario all default).
 SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
+# ---------------------------------------------------------------------------
+# Scenario-matrix grid (tools/chaos_run.py --matrix): the default sweep of
+# scenarios x seeds x committee sizes whose consolidated report
+# (CHAOS_MATRIX_rN.json) is the regression harness for every scale claim
+# the ROADMAP makes. Grid scenarios must be COMMITTEE-SIZE-INVARIANT:
+# faults expressed as per-link defaults or single-node crash windows, no
+# hardcoded committee subsets (tools/lint_metrics.py lint_matrix enforces
+# both that every name resolves here and that none pins a committee).
+MATRIX_SCENARIOS = ("baseline", "lossy_links", "leader_crash")
+MATRIX_SEEDS = (1, 2)
+MATRIX_SIZES = (4, 64)
+# Cells at/above this committee size run the trusted-crypto stub
+# (chaos/trusted_crypto.py): exact-int pysigner at 64 nodes costs ~minutes
+# of wall time PER ROUND, which is exactly what the stub exists to remove.
+TRUSTED_CRYPTO_MIN_N = 16
+# Virtual-seconds cap per matrix cell: grid scenarios early-stop on their
+# commit floors well before this; the cap bounds a regressed cell's wall
+# cost instead of letting it soak its full scenario duration. 30 (not
+# 15): lossy links at 64 nodes can cost a multi-round pacemaker stall
+# with backed-off 8 s timeouts before healing (observed at seed 2 —
+# rounds 8-12, ~10 virtual seconds), and the cap must leave room for the
+# slowest node to reach the scenario's commit floor AFTER such a stall.
+MATRIX_CELL_DURATION_S = 30.0
+
+
+def matrix_telemetry_config() -> TelemetryConfig:
+    """Per-node telemetry for matrix cells: snapshots fast enough that a
+    short early-stopping cell still fills a few windows (the fleet rollup
+    merges these rings), rings small enough that a 100-node cell's report
+    stays tractable."""
+    return TelemetryConfig(interval_s=0.5, ring=64, dump_snapshots=4)
+
+
+def cell_name(scenario: str, seed: int, n: int) -> str:
+    """The stable cell key regression diffs join on."""
+    return f"{scenario}@s{seed}/n{n}"
+
+
+def run_matrix_cell(
+    scenario: str,
+    seed: int,
+    n: int,
+    trusted: str = "auto",
+    wan: bool = True,
+    duration: float | None = MATRIX_CELL_DURATION_S,
+) -> dict:
+    """Execute one matrix cell and distill it to the committed record:
+    verdict + fleet telemetry rollup (utils/telemetry.fleet_rollup), with
+    the heavy per-scenario sections (fault trace, flight recorders, raw
+    telemetry rings) dropped — a 12-cell matrix with 64-node cells must
+    stay a reviewable artifact. `trusted` is auto|on|off; auto stubs
+    crypto from TRUSTED_CRYPTO_MIN_N nodes up (the committee size where
+    exact-int pysigner stops being runnable on one box)."""
+    import time as _time
+
+    from ..utils.telemetry import fleet_rollup
+    from .plan import WanMatrix
+
+    if trusted not in ("auto", "on", "off"):
+        raise ValueError(f"trusted must be auto|on|off, got {trusted!r}")
+    trusted_crypto = (
+        trusted == "on" or (trusted == "auto" and n >= TRUSTED_CRYPTO_MIN_N)
+    )
+    t0 = _time.perf_counter()
+    report = run_scenario(
+        scenario,
+        seed,
+        duration=duration,
+        n=n,
+        trusted_crypto=trusted_crypto,
+        wan=WanMatrix() if wan else None,
+        telemetry=matrix_telemetry_config(),
+    )
+    wall = _time.perf_counter() - t0
+    return {
+        "cell": cell_name(scenario, seed, n),
+        "scenario": scenario,
+        "seed": seed,
+        "n": n,
+        "crypto_mode": report["crypto_mode"],
+        "wan": wan,
+        "green": bool(report["ok"]),
+        "wall_seconds": round(wall, 3),
+        "virtual_seconds": report["virtual_seconds"],
+        "violations": {
+            "safety": report["safety_violations"][:5],
+            "liveness": report["liveness_violations"][:5],
+            "expectations": report.get("expectation_failures", [])[:5],
+        },
+        "rollup": fleet_rollup(report),
+    }
+
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
-    "telemetry.", "sync.", "reconfig.",
+    "telemetry.", "sync.", "reconfig.", "wan.",
 )
 
 
@@ -763,28 +855,58 @@ def _counter_snapshot() -> dict:
     }
 
 
-def run_scenario(name: str, seed: int, duration: float | None = None) -> dict:
+def run_scenario(
+    name: str,
+    seed: int,
+    duration: float | None = None,
+    n: int | None = None,
+    trusted_crypto: bool = False,
+    wan: "object | None" = None,
+    telemetry: TelemetryConfig | None = None,
+) -> dict:
     """Execute one named scenario on a fresh VirtualTimeLoop; returns the
     report dict (see ChaosOrchestrator._report) extended with the scenario
-    name, metric deltas, and expectation failures folded into `ok`."""
+    name, metric deltas, and expectation failures folded into `ok`.
+
+    The fleet overrides (all default-off, so committed determinism pins
+    replay unchanged): `n` scales the committee — only valid for
+    scenarios without a pinned committee subset; `trusted_crypto` swaps
+    signatures for the keyed-hash stub (chaos/trusted_crypto.py — read
+    its trust model first); `wan` attaches a plan.WanMatrix of per-region
+    RTT classes; `telemetry` forces a per-node TelemetryPlane config (the
+    matrix runner's rollup source) over the scenario's own."""
     scenario = SCENARIOS[name]
+    if n is not None and scenario.committee is not None:
+        raise ValueError(
+            f"scenario {name!r} pins committee indices "
+            f"{scenario.committee}; its node count cannot be overridden"
+        )
+    plan = scenario.plan()
+    if wan is not None:
+        plan.wan = wan
+    telemetry_config = (
+        telemetry
+        if telemetry is not None
+        else (scenario.telemetry() if scenario.telemetry else None)
+    )
     before = _counter_snapshot()
 
     async def body() -> dict:
         orch = ChaosOrchestrator(
             seed=seed,
-            n=scenario.n,
-            plan=scenario.plan(),
+            n=n if n is not None else scenario.n,
+            plan=plan,
             byzantine=dict(scenario.byzantine),
             parameters=scenario.parameters(),
             ingress=scenario.ingress() if scenario.ingress else None,
             flood=scenario.flood() if scenario.flood else None,
             scheduler_config=scenario.scheduler() if scenario.scheduler else None,
-            telemetry_config=scenario.telemetry() if scenario.telemetry else None,
+            telemetry_config=telemetry_config,
             committee_indices=(
                 list(scenario.committee) if scenario.committee is not None else None
             ),
             reconfig=scenario.reconfig() if scenario.reconfig else None,
+            trusted_crypto=trusted_crypto,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

@@ -48,6 +48,9 @@ _M_DUPLICATES = metrics.counter("chaos.duplicates")
 _M_REORDERS = metrics.counter("chaos.reorders")
 _M_PARTITION_DROPS = metrics.counter("chaos.partition_drops")
 _M_UNROUTED = metrics.counter("chaos.unrouted")
+_M_TRACE_DROPPED = metrics.counter("chaos.fault_trace_dropped")
+_M_WAN_FRAMES = metrics.counter("wan.frames")
+_M_WAN_CROSS = metrics.counter("wan.cross_region_frames")
 _M_NET_FRAMES_RECEIVED = metrics.counter("net.frames_received")
 _M_NET_BYTES_RECEIVED = metrics.counter("net.bytes_received")
 _M_NET_DECODE_ERRORS = metrics.counter("net.decode_errors")
@@ -81,6 +84,13 @@ class FaultyTransport:
         self._policies: dict[int, object] = {}
         self.trace: list[dict] = []
         self.trace_overflow = 0
+        # WAN topology: region per node index, a pure function of the
+        # master seed (stream "wan:regions" — adding the matrix to a plan
+        # cannot shift any link-fault stream's decisions).
+        self.regions: list[str] = []
+        if plan.wan is not None:
+            n = max(self.node_of_port.values(), default=-1) + 1
+            self.regions = plan.wan.assign(rng.stream("wan:regions"), n)
 
     # -- NetReceiver seam ----------------------------------------------------
 
@@ -157,6 +167,14 @@ class FaultyTransport:
             self._record(now, src, dst, seq, "drop")
             return
         delay = lf.delay + lf.jitter * r_jitter
+        if self.regions:
+            # WAN class on top of the link-quality faults: the pair's
+            # one-way latency, looked up by each endpoint's region.
+            src_region, dst_region = self.regions[src], self.regions[dst]
+            delay += self.plan.wan.one_way_s(src_region, dst_region)
+            _M_WAN_FRAMES.inc()
+            if src_region != dst_region:
+                _M_WAN_CROSS.inc()
         if r_reorder < lf.reorder:
             delay += lf.reorder_delay
             _M_REORDERS.inc()
@@ -242,7 +260,12 @@ class FaultyTransport:
                 label=dst,
             )
         if len(self.trace) >= TRACE_CAP:
+            # Silent truncation was the old failure mode: a 100-node run
+            # blows the cap in seconds and the report's trace looked
+            # complete. The counter + the report's `fault_trace_truncated`
+            # flag make the cut visible.
             self.trace_overflow += 1
+            _M_TRACE_DROPPED.inc()
             return
         entry = {"t": round(t, 6), "src": src, "dst": dst, "seq": seq, "action": action}
         for k, v in extra.items():

@@ -38,6 +38,11 @@ __all__ = [
     "keypair_from_seed",
     "sign",
     "verify",
+    "keypair_exact",
+    "sign_exact",
+    "verify_exact",
+    "install_scheme",
+    "active_scheme",
     "PurePythonBackend",
     "PySignatureService",
 ]
@@ -137,7 +142,60 @@ def _clamp(h: bytes) -> int:
     return a
 
 
+# ---------------------------------------------------------------------------
+# Scheme seam. The chaos plane's trusted-crypto mode (chaos/trusted_crypto.py)
+# swaps signatures for keyed-hash stubs at hundred-node committee sizes,
+# where exact-int ed25519 (~20 ms/sig here) would make a single round cost
+# minutes of wall time. Everything that signs or verifies through this
+# module — PySignatureService, PurePythonBackend, byzantine policies,
+# EpochChange.new_from_seed, the SafetyChecker audit — follows one installed
+# scheme, so a run is never half-stubbed. The `*_exact` names below always
+# resolve to the real RFC 8032 implementation regardless of any scheme.
+
+_SCHEME = None  # None = exact RFC 8032 (the default, production semantics)
+
+
+def install_scheme(scheme):
+    """Install a signature scheme (or None for exact RFC 8032); returns
+    the previously installed scheme so callers can restore it. A scheme
+    supplies keypair_from_seed/sign/verify with this module's shapes
+    (32-byte seeds and keys, 64-byte signatures)."""
+    global _SCHEME
+    prev = _SCHEME
+    _SCHEME = scheme
+    return prev
+
+
+def active_scheme():
+    return _SCHEME
+
+
 def keypair_from_seed(seed: bytes) -> tuple[bytes, bytes]:
+    """32-byte seed -> (public key, seed). The seed IS the secret; signing
+    re-derives whatever the active scheme needs from it."""
+    if _SCHEME is not None:
+        return _SCHEME.keypair_from_seed(seed)
+    return keypair_exact(seed)
+
+
+def sign(seed: bytes, message: bytes) -> bytes:
+    """64-byte signature over `message` under the active scheme (exact
+    RFC 8032 unless a chaos scheme is installed)."""
+    if _SCHEME is not None:
+        return _SCHEME.sign(seed, message)
+    return sign_exact(seed, message)
+
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    """Verify under the active scheme. Exact in BOTH modes: the default
+    is strict exact-integer RFC 8032; a stub scheme recomputes its keyed
+    hash and compares byte-exactly (so corruption always rejects)."""
+    if _SCHEME is not None:
+        return _SCHEME.verify(public_key, message, signature)
+    return verify_exact(public_key, message, signature)
+
+
+def keypair_exact(seed: bytes) -> tuple[bytes, bytes]:
     """32-byte seed -> (compressed public key, seed). The seed IS the
     secret (RFC 8032 private key); signing re-derives the scalar."""
     if len(seed) != 32:
@@ -147,8 +205,10 @@ def keypair_from_seed(seed: bytes) -> tuple[bytes, bytes]:
     return pk, seed
 
 
-def sign(seed: bytes, message: bytes) -> bytes:
+def sign_exact(seed: bytes, message: bytes) -> bytes:
     """RFC 8032 Ed25519 signature (64 bytes) over `message`."""
+    if len(seed) != 32:
+        raise ValueError("ed25519 seed must be 32 bytes")
     h = hashlib.sha512(seed).digest()
     a, prefix = _clamp(h), h[32:]
     pk = _pt_compress(_pt_mul(a, _B_POINT))
@@ -169,7 +229,7 @@ _KEY_CACHE: dict[bytes, tuple] = {}
 _KEY_CACHE_MAX = 4096
 
 
-def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+def verify_exact(public_key: bytes, message: bytes, signature: bytes) -> bool:
     """STRICT verification: canonical s < L, on-curve canonical A and R,
     full sB == R + hA — the same rejection classes the device kernels
     implement (tests assert mask equality)."""
@@ -203,10 +263,11 @@ def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
 
 
 class PurePythonBackend(CryptoBackend):
-    """CryptoBackend over the exact-integer verifier. The chaos runner
-    installs this so fault scenarios run the REAL verification flow
-    (BatchVerificationService -> backend) on hosts with neither the
-    OpenSSL wheel nor a warmed-up accelerator."""
+    """CryptoBackend over the module-level verifier (exact-integer by
+    default; the active scheme under a chaos trusted-crypto run). The
+    chaos runner installs this so fault scenarios run the REAL
+    verification flow (BatchVerificationService -> backend) on hosts
+    with neither the OpenSSL wheel nor a warmed-up accelerator."""
 
     name = "pure-python"
 

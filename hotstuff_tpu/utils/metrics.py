@@ -633,6 +633,19 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("chaos.late_boots", "counter", None),
     ("chaos.invariant_checks", "counter", None),
     ("chaos.invariant_violations", "counter", None),
+    ("chaos.fault_trace_dropped", "counter", None),
+    # chaos/trusted_crypto.py — keyed-hash stub signature scheme
+    ("chaos.stub_signs", "counter", None),
+    ("chaos.stub_verifies", "counter", None),
+    ("chaos.stub_rejects", "counter", None),
+    # chaos/plan.py WanMatrix via chaos/transport.py — per-region RTT classes
+    ("wan.frames", "counter", None),
+    ("wan.cross_region_frames", "counter", None),
+    # tools/chaos_run.py --matrix — scenario-matrix regression harness
+    ("matrix.cells", "counter", None),
+    ("matrix.cells_green", "counter", None),
+    ("matrix.cells_red", "counter", None),
+    ("matrix.regressions", "counter", None),
     # utils/tracing.py — causal tracing + flight recorder
     ("trace.events", "counter", None),
     ("trace.dropped", "counter", None),

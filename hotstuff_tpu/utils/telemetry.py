@@ -74,6 +74,9 @@ __all__ = [
     "scrape",
     "scrape_sync",
     "serve_in_thread",
+    "weighted_percentile",
+    "merge_lane_summaries",
+    "fleet_rollup",
 ]
 
 _M_SNAPSHOTS = metrics.counter("telemetry.snapshots")
@@ -583,6 +586,199 @@ class TelemetryPlane:
             "device": self._timeline_fn() if self._timeline_fn else None,
             "commits": commits,
         }
+
+
+# ---------------------------------------------------------------------------
+# Fleet rollups: merge many nodes' telemetry into one cell record (the
+# scenario-matrix runner's per-cell summary — tools/chaos_run.py --matrix).
+#
+# Cross-node percentile merge rule (documented because it is an
+# approximation, not magic): true percentiles are not mergeable from
+# per-node summaries, and per-node planes deliberately ship summaries,
+# not sample rings (a 100-node cell would otherwise carry ~100x65k
+# floats). Each node-lane summary (count, p50, p99, max) is therefore
+# re-expanded into three weighted points — 50% of the count at p50, 49%
+# at p99, the remainder at max — and the fleet percentile is the
+# weighted nearest-rank over the pooled points. Exactness properties:
+# the merged max is EXACT (max of maxes); the merged p99 is bounded
+# above by the worst node's max and below by the best node's p50; and
+# when every node saw the same distribution the merge reproduces that
+# distribution's summary. Rollups additionally carry the worst NODE per
+# lane, which needs no merge at all and is usually the number a
+# regression hunt starts from.
+
+
+def weighted_percentile(points: list[tuple[float, float]], q: float) -> float:
+    """Nearest-rank percentile over (value, weight) points: the smallest
+    value whose cumulative weight reaches q of the total. Degenerates to
+    metrics.percentile when every weight is 1."""
+    if not points:
+        return 0.0
+    total = sum(w for _v, w in points if w > 0)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for v, w in sorted(points):
+        if w <= 0:
+            continue
+        cum += w
+        if cum >= target - 1e-12:
+            return v
+    return sorted(points)[-1][0]
+
+
+def merge_lane_summaries(per_node: dict[str, dict]) -> dict[str, dict]:
+    """{node: {lane: {count, p50_ms, p99_ms[, max_ms]}}} -> one merged
+    summary per lane across the fleet (see the merge rule above), plus
+    the worst node by p99 — {lane: {count, p50_ms, p99_ms, max_ms,
+    worst_node, worst_node_p99_ms}}."""
+    pooled: dict[str, list[tuple[float, float]]] = {}
+    counts: dict[str, int] = {}
+    worst: dict[str, tuple[float, str]] = {}  # lane -> (p99, node)
+    for node, lanes in sorted(per_node.items()):
+        for lane, s in (lanes or {}).items():
+            count = int(s.get("count", 0))
+            if count <= 0:
+                continue
+            p50 = float(s.get("p50_ms", 0.0))
+            p99 = float(s.get("p99_ms", p50))
+            mx = float(s.get("max_ms", p99))
+            # Fractional weights on purpose: integer rounding would skew
+            # the max share above 1% for small counts, dragging the
+            # merged p99 of IDENTICAL per-node distributions up to max —
+            # the fixed-point property the unit test pins.
+            w50 = 0.50 * count
+            w99 = 0.49 * count
+            wmax = 0.01 * count
+            pooled.setdefault(lane, []).extend(
+                [(p50, w50), (p99, w99), (mx, wmax)]
+            )
+            counts[lane] = counts.get(lane, 0) + count
+            if lane not in worst or p99 > worst[lane][0]:
+                worst[lane] = (p99, str(node))
+    out = {}
+    for lane, points in pooled.items():
+        out[lane] = {
+            "count": counts[lane],
+            "p50_ms": round(weighted_percentile(points, 0.50), 3),
+            "p99_ms": round(weighted_percentile(points, 0.99), 3),
+            "max_ms": round(max(v for v, w in points if w > 0), 3),
+            "worst_node": worst[lane][1],
+            "worst_node_p99_ms": round(worst[lane][0], 3),
+        }
+    return out
+
+
+# Counter prefixes a matrix cell keeps from the scenario's metric deltas:
+# the scale/health counters a regression diff is judged on, not the full
+# delta dump (which stays in the per-scenario report).
+_ROLLUP_COUNTER_PREFIXES = ("sync.", "reconfig.", "wan.", "chaos.")
+
+
+def fleet_rollup(report: dict) -> dict:
+    """Distill one chaos report (ChaosOrchestrator._report shape) into
+    the fleet-wide cell summary the scenario matrix commits: safety/
+    liveness verdict, commit rate, cross-node lane-percentile merge,
+    worst-node occupancy, alert totals, and the sync/epoch/wan counters.
+    Pure function of the report, so offline tooling (telemetry_dash
+    --matrix) reproduces the runner's numbers from the artifact alone."""
+    span = float(report.get("virtual_seconds") or 0.0)
+    # Per-node counts from the report's `commits` map when present: the
+    # orchestrator builds it over EVERY node, so a fully-starved node
+    # contributes its 0 to min_node. commit_times only lists nodes that
+    # committed at least once — using it alone would report a healthy
+    # floor while a node sat at zero.
+    commits_map = report.get("commits")
+    per_node_commits = {
+        str(k): len(v)
+        for k, v in (
+            commits_map if commits_map else report.get("commit_times") or {}
+        ).items()
+    }
+    total_commits = sum(per_node_commits.values())
+
+    telem = report.get("telemetry") or {}
+    # Lane summaries: prefer the telemetry dumps' cumulative LaneStats;
+    # telemetry-less reports degrade to the scheduler section's
+    # queue_delay (same {count, p50_ms, p99_ms, max_ms} shape).
+    lane_src = (
+        {label: dump.get("lanes") or {} for label, dump in telem.items()}
+        if telem
+        else {
+            label: (s or {}).get("queue_delay") or {}
+            for label, s in (report.get("scheduler") or {}).items()
+        }
+    )
+    occupancies = {
+        str(label): dump["device"]["occupancy"]
+        for label, dump in telem.items()
+        if isinstance(dump.get("device"), dict)
+        and dump["device"].get("occupancy") is not None
+    }
+    worst_occ = min(occupancies.items(), key=lambda kv: kv[1], default=None)
+    alerts_fired = sum(
+        1
+        for dump in telem.values()
+        for a in dump.get("alerts") or ()
+        if a.get("event") == "fired"
+    )
+    alerts_cleared = sum(
+        1
+        for dump in telem.values()
+        for a in dump.get("alerts") or ()
+        if a.get("event") == "cleared"
+    )
+    active = sorted(
+        {
+            f"{label}:{name}"
+            for label, dump in telem.items()
+            for name in dump.get("active_alerts") or ()
+        }
+    )
+    metrics_delta = report.get("metrics") or {}
+    return {
+        "nodes": report.get("nodes"),
+        "crypto_mode": report.get("crypto_mode", "exact"),
+        "wan_regions": sorted(set((report.get("wan_regions") or {}).values())),
+        "virtual_seconds": span,
+        "verdict": {
+            "ok": bool(report.get("ok")),
+            "safety_violations": len(report.get("safety_violations") or ()),
+            "liveness_violations": len(report.get("liveness_violations") or ()),
+            "expectation_failures": len(
+                report.get("expectation_failures") or ()
+            ),
+        },
+        "commits": {
+            "total": total_commits,
+            "rate_per_s": round(total_commits / span, 3) if span > 0 else 0.0,
+            "min_node": min(per_node_commits.values(), default=0),
+            "max_node": max(per_node_commits.values(), default=0),
+        },
+        "lanes": merge_lane_summaries(lane_src),
+        "occupancy": {
+            "worst_node": worst_occ[0] if worst_occ else None,
+            "worst": round(worst_occ[1], 6) if worst_occ else None,
+        },
+        "alerts": {
+            "fired": alerts_fired,
+            "cleared": alerts_cleared,
+            "active": active,
+        },
+        "snapshots": sum(
+            len(dump.get("snapshots") or ()) for dump in telem.values()
+        ),
+        "epoch_switches": sum(
+            len(v) for v in (report.get("epoch_switches") or {}).values()
+        ),
+        "counters": {
+            k: v
+            for k, v in sorted(metrics_delta.items())
+            if k.startswith(_ROLLUP_COUNTER_PREFIXES)
+        },
+        "fault_trace_truncated": bool(report.get("fault_trace_truncated")),
+    }
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,413 @@
+"""Fleet observatory tests (ISSUE 12): the trusted-crypto stub scheme and
+its pysigner seam, the WAN latency matrix, fault-trace truncation
+signalling, cross-node telemetry rollups, the scenario-matrix cell
+runner, and the tier-1 64-node baseline smoke.
+
+Dependency-free (no `cryptography`, no jax): everything runs on pysigner
+or its keyed-hash stub, on the VirtualTimeLoop.
+"""
+
+import pytest
+
+from hotstuff_tpu.chaos import SeededRng, WanMatrix, run_scenario
+from hotstuff_tpu.chaos.trusted_crypto import TrustedCryptoScheme, stub_signature
+from hotstuff_tpu.crypto import pysigner
+from hotstuff_tpu.utils.telemetry import (
+    TelemetryConfig,
+    fleet_rollup,
+    merge_lane_summaries,
+    weighted_percentile,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# --- trusted-crypto stub scheme ---------------------------------------------
+
+
+def test_stub_scheme_sign_verify_roundtrip_and_rejections():
+    scheme = TrustedCryptoScheme()
+    pk, seed = scheme.keypair_from_seed(b"\x07" * 32)
+    assert len(pk) == 32 and seed == b"\x07" * 32
+    sig = scheme.sign(seed, b"hello fleet")
+    assert len(sig) == 64
+    assert scheme.verify(pk, b"hello fleet", sig)
+    # every corruption class rejects: garbage, tampered message, wrong
+    # key, single flipped signature byte (byte-exact recomputation)
+    assert not scheme.verify(pk, b"hello fleet", b"\x00" * 64)
+    assert not scheme.verify(pk, b"hello fleeT", sig)
+    other_pk, _ = scheme.keypair_from_seed(b"\x08" * 32)
+    assert not scheme.verify(other_pk, b"hello fleet", sig)
+    bad = bytearray(sig)
+    bad[17] ^= 1
+    assert not scheme.verify(pk, b"hello fleet", bytes(bad))
+
+
+def test_stub_scheme_is_deterministic_and_keyed_by_pk():
+    a = TrustedCryptoScheme()
+    b = TrustedCryptoScheme()
+    pk_a, _ = a.keypair_from_seed(b"\x01" * 32)
+    pk_b, _ = b.keypair_from_seed(b"\x01" * 32)
+    assert pk_a == pk_b  # pure function of the seed, instance-free
+    assert a.sign(b"\x01" * 32, b"m") == b.sign(b"\x01" * 32, b"m")
+    assert stub_signature(pk_a, b"m") == a.sign(b"\x01" * 32, b"m")
+    # different keys give different stubs for the same message
+    pk2, _ = a.keypair_from_seed(b"\x02" * 32)
+    assert stub_signature(pk_a, b"m") != stub_signature(pk2, b"m")
+
+
+def test_pysigner_scheme_seam_installs_and_restores():
+    """Module-level sign/verify/keypair delegate to the installed scheme;
+    the *_exact names never do — the seam the SafetyChecker's audit and
+    the chaos orchestrator both rely on."""
+    seed = b"\x05" * 32
+    exact_pk, _ = pysigner.keypair_exact(seed)
+    scheme = TrustedCryptoScheme()
+    prev = pysigner.install_scheme(scheme)
+    try:
+        assert pysigner.active_scheme() is scheme
+        stub_pk, _ = pysigner.keypair_from_seed(seed)
+        assert stub_pk != exact_pk  # stub keys are hash-derived
+        sig = pysigner.sign(seed, b"msg")
+        assert pysigner.verify(stub_pk, b"msg", sig)
+        assert not pysigner.verify(stub_pk, b"msg", b"\xff" * 64)
+        # exact names stay exact under an installed scheme
+        assert pysigner.keypair_exact(seed)[0] == exact_pk
+        exact_sig = pysigner.sign_exact(seed, b"msg")
+        assert pysigner.verify_exact(exact_pk, b"msg", exact_sig)
+        assert not pysigner.verify_exact(exact_pk, b"msg", sig)
+    finally:
+        pysigner.install_scheme(prev)
+    assert pysigner.active_scheme() is prev
+    # restored: module-level calls are exact again
+    assert pysigner.keypair_from_seed(seed)[0] == exact_pk
+
+
+def test_safety_checker_audit_catches_corrupted_qc_under_stub():
+    """The committed-QC audit keeps its zero-false-accept contract in
+    trusted-crypto mode: a quorate QC of genuine stub signatures passes,
+    and flipping ONE byte of one vote signature is flagged as a FALSE
+    ACCEPT — the audit is an exact recomputation, not a trust-me."""
+    from hotstuff_tpu.chaos.invariants import SafetyChecker
+    from hotstuff_tpu.consensus.config import Committee
+    from hotstuff_tpu.consensus.messages import QC, Block, _vote_digest
+    from hotstuff_tpu.crypto.primitives import Digest, PublicKey, Signature
+
+    scheme = TrustedCryptoScheme()
+    prev = pysigner.install_scheme(scheme)
+    try:
+        keys = sorted(
+            scheme.keypair_from_seed(bytes([i + 1]) * 32) for i in range(4)
+        )
+        keys = [(PublicKey(pk), s) for pk, s in keys]
+        committee = Committee.new(
+            [(pk, 1, ("127.0.0.1", 9_000 + i)) for i, (pk, _s) in enumerate(keys)]
+        )
+        parent = Digest(b"\x01" * 32)
+        signed = _vote_digest(parent, 1).data
+        votes = tuple(
+            (pk, Signature(pysigner.sign(s, signed))) for pk, s in keys[:3]
+        )
+        block = Block(
+            QC(parent, 1, votes),
+            None,
+            keys[0][0],
+            2,
+            (Digest(b"\x02" * 32),),
+            Signature(bytes(64)),
+        )
+        checker = SafetyChecker(committee)
+        checker.on_commit(0, block)
+        assert checker.violations == []
+
+        corrupted = bytearray(votes[0][1].data)
+        corrupted[0] ^= 1
+        bad_votes = ((votes[0][0], Signature(bytes(corrupted))),) + votes[1:]
+        bad_block = Block(
+            QC(parent, 1, bad_votes),
+            None,
+            keys[0][0],
+            2,
+            (Digest(b"\x03" * 32),),
+            Signature(bytes(64)),
+        )
+        checker2 = SafetyChecker(committee)
+        checker2.on_commit(0, bad_block)
+        assert any("FALSE ACCEPT" in v for v in checker2.violations)
+    finally:
+        pysigner.install_scheme(prev)
+
+
+def test_forged_stub_votes_still_rejected_end_to_end():
+    """The SigForger's garbage-signature flood dies in the verification
+    rejection lanes under the stub exactly as under exact crypto: nonzero
+    rejections, zero forged triples cached, no false accept in any
+    committed QC."""
+    report = run_scenario("forged_signatures", seed=13, trusted_crypto=True)
+    assert report["ok"], report
+    assert report["crypto_mode"] == "trusted-stub"
+    assert report["metrics"]["chaos.forged_votes"] > 0
+    assert report["metrics"]["verifier.rejected_sigs"] > 0
+    assert report["metrics"]["chaos.stub_rejects"] > 0
+    assert report["forged_triples_cached"] == 0
+    assert not any("FALSE ACCEPT" in v for v in report["safety_violations"])
+
+
+# --- WAN latency matrix -----------------------------------------------------
+
+
+def test_wan_matrix_delays_and_assignment():
+    wan = WanMatrix()
+    # symmetric, and intra-region is the cheapest class
+    assert wan.one_way_s("us-east", "eu-west") == wan.one_way_s("eu-west", "us-east")
+    intra = wan.one_way_s("us-east", "us-east")
+    assert intra == pytest.approx(0.002)
+    assert all(
+        wan.one_way_s(a, b) > intra
+        for a in wan.regions
+        for b in wan.regions
+        if a != b
+    )
+    # deterministic, seed-dependent, balanced assignment
+    r1 = wan.assign(SeededRng(1).stream("wan:regions"), 10)
+    r1b = wan.assign(SeededRng(1).stream("wan:regions"), 10)
+    r2 = wan.assign(SeededRng(2).stream("wan:regions"), 10)
+    assert r1 == r1b and r1 != r2
+    counts = {reg: r1.count(reg) for reg in wan.regions}
+    assert max(counts.values()) - min(counts.values()) <= 1
+    # an incomplete RTT table is a config error, not a silent KeyError
+    with pytest.raises(ValueError):
+        WanMatrix(regions=("a", "b", "c"), rtt_ms=(("a", "b", 10.0),))
+
+
+def test_wan_matrix_applies_per_region_latency_in_scenarios():
+    report = run_scenario("baseline", seed=3, wan=WanMatrix())
+    assert report["ok"], report
+    assert sorted(report["wan_regions"]) == ["0", "1", "2", "3"]
+    assert report["metrics"]["wan.frames"] > 0
+    # region map and fault trace replay bit-identically
+    again = run_scenario("baseline", seed=3, wan=WanMatrix())
+    assert again["wan_regions"] == report["wan_regions"]
+    assert again["fault_trace"] == report["fault_trace"]
+    # and the WAN-less default carries an empty region map (unchanged
+    # historical behaviour — the committed determinism pins rely on it)
+    plain = run_scenario("baseline", seed=3)
+    assert plain["wan_regions"] == {}
+    assert "wan.frames" not in plain["metrics"]
+
+
+# --- fault-trace truncation signal ------------------------------------------
+
+
+def test_fault_trace_truncation_is_signalled(monkeypatch):
+    """Satellite: the 20k-entry trace cap used to drop entries silently.
+    With a tiny cap, the report must flag the truncation and the
+    chaos.fault_trace_dropped counter must advance."""
+    from hotstuff_tpu.chaos import transport as tr
+    from hotstuff_tpu.utils import metrics
+
+    monkeypatch.setattr(tr, "TRACE_CAP", 10)
+    report = run_scenario("baseline", seed=1)
+    assert report["fault_trace_truncated"] is True
+    assert report["fault_trace_overflow"] > 0
+    assert len(report["fault_trace"]) == 10
+    assert report["metrics"]["chaos.fault_trace_dropped"] == report[
+        "fault_trace_overflow"
+    ]
+    assert metrics.REGISTRY.counter("chaos.fault_trace_dropped").value > 0
+
+
+def test_untruncated_trace_not_flagged():
+    report = run_scenario("baseline", seed=1)
+    assert report["fault_trace_truncated"] is False
+    assert "chaos.fault_trace_dropped" not in report["metrics"]
+
+
+# --- cross-node telemetry rollups -------------------------------------------
+
+
+def test_weighted_percentile_nearest_rank():
+    assert weighted_percentile([], 0.5) == 0.0
+    assert weighted_percentile([(5.0, 0.0)], 0.5) == 0.0
+    # degenerates to plain nearest-rank at unit weights
+    pts = [(1.0, 1.0), (2.0, 1.0), (3.0, 1.0), (4.0, 1.0)]
+    assert weighted_percentile(pts, 0.50) == 2.0
+    assert weighted_percentile(pts, 1.00) == 4.0
+    # weights shift the rank: 90% of mass at 1.0 pins p50 there
+    assert weighted_percentile([(1.0, 9.0), (100.0, 1.0)], 0.50) == 1.0
+    assert weighted_percentile([(1.0, 9.0), (100.0, 1.0)], 0.95) == 100.0
+
+
+def test_merge_lane_summaries_hand_computed():
+    """The documented merge rule, on paper: node A (count 100, p50 1,
+    p99 9, max 10) + node B (count 100, p50 3, p99 5, max 6) pool into
+    weighted points whose 50th percentile lands on B's p50 and whose
+    99th lands on A's p99; the max is the exact max of maxes and the
+    worst node by p99 is A."""
+    merged = merge_lane_summaries(
+        {
+            "a": {"consensus": {"count": 100, "p50_ms": 1.0, "p99_ms": 9.0, "max_ms": 10.0}},
+            "b": {"consensus": {"count": 100, "p50_ms": 3.0, "p99_ms": 5.0, "max_ms": 6.0}},
+        }
+    )
+    lane = merged["consensus"]
+    assert lane["count"] == 200
+    assert lane["p50_ms"] == 3.0
+    assert lane["p99_ms"] == 9.0
+    assert lane["max_ms"] == 10.0
+    assert lane["worst_node"] == "a" and lane["worst_node_p99_ms"] == 9.0
+
+
+def test_merge_lane_summaries_identical_distributions_fixed_point():
+    one = {"mempool": {"count": 50, "p50_ms": 2.0, "p99_ms": 8.0, "max_ms": 9.0}}
+    merged = merge_lane_summaries({"x": one, "y": one, "z": one})
+    lane = merged["mempool"]
+    assert lane["count"] == 150
+    assert lane["p50_ms"] == 2.0
+    assert lane["p99_ms"] == 8.0
+    assert lane["max_ms"] == 9.0
+    # empty lanes and zero counts are skipped, not zero-merged
+    assert merge_lane_summaries({"x": {}, "y": {"mempool": {"count": 0}}}) == {}
+
+
+def test_fleet_rollup_from_synthetic_report():
+    report = {
+        "nodes": 2,
+        "ok": True,
+        "crypto_mode": "trusted-stub",
+        "wan_regions": {"0": "eu-west", "1": "us-east"},
+        "virtual_seconds": 10.0,
+        "safety_violations": [],
+        "liveness_violations": [],
+        "expectation_failures": [],
+        "commit_times": {"0": [1.0, 2.0, 3.0], "1": [1.5, 2.5]},
+        "epoch_switches": {"0": [{"epoch": 2}], "1": [{"epoch": 2}]},
+        "metrics": {"sync.range_blocks": 7, "wan.frames": 40, "net.frames_sent": 9},
+        "fault_trace_truncated": True,
+        "telemetry": {
+            "0": {
+                "snapshots": [{"seq": 0}, {"seq": 1}],
+                "lanes": {"consensus": {"count": 10, "p50_ms": 1.0, "p99_ms": 2.0, "max_ms": 3.0}},
+                "alerts": [{"event": "fired"}, {"event": "cleared"}],
+                "active_alerts": [],
+                "device": {"occupancy": 0.9},
+            },
+            "1": {
+                "snapshots": [{"seq": 0}],
+                "lanes": {"consensus": {"count": 10, "p50_ms": 1.0, "p99_ms": 4.0, "max_ms": 5.0}},
+                "alerts": [],
+                "active_alerts": ["lane.mempool"],
+                "device": {"occupancy": 0.7},
+            },
+        },
+    }
+    rollup = fleet_rollup(report)
+    assert rollup["verdict"] == {
+        "ok": True,
+        "safety_violations": 0,
+        "liveness_violations": 0,
+        "expectation_failures": 0,
+    }
+    assert rollup["commits"] == {
+        "total": 5,
+        "rate_per_s": 0.5,
+        "min_node": 2,
+        "max_node": 3,
+    }
+    assert rollup["lanes"]["consensus"]["worst_node"] == "1"
+    assert rollup["occupancy"] == {"worst_node": "1", "worst": 0.7}
+    assert rollup["alerts"] == {
+        "fired": 1,
+        "cleared": 1,
+        "active": ["1:lane.mempool"],
+    }
+    assert rollup["snapshots"] == 3
+    assert rollup["epoch_switches"] == 2
+    # only the scale/health counter prefixes ride into the cell record
+    assert rollup["counters"] == {"sync.range_blocks": 7, "wan.frames": 40}
+    assert rollup["fault_trace_truncated"] is True
+    assert rollup["wan_regions"] == ["eu-west", "us-east"]
+
+    # a fully-starved node must drag min_node to 0: the complete
+    # `commits` map (every node, committed or not) takes precedence over
+    # commit_times, which only lists nodes that committed at least once
+    report["commits"] = {
+        "0": [[1, "d1"], [2, "d2"], [3, "d3"]],
+        "1": [[1, "d1"], [2, "d2"]],
+        "2": [],
+    }
+    starved = fleet_rollup(report)
+    assert starved["commits"] == {
+        "total": 5,
+        "rate_per_s": 0.5,
+        "min_node": 0,
+        "max_node": 3,
+    }
+
+
+# --- matrix cells & overrides -----------------------------------------------
+
+
+def test_run_scenario_rejects_n_override_on_pinned_committee():
+    with pytest.raises(ValueError):
+        run_scenario("epoch_reconfig", seed=1, n=64)
+
+
+def test_run_matrix_cell_record_shape():
+    from hotstuff_tpu.chaos.scenarios import run_matrix_cell
+
+    cell = run_matrix_cell("baseline", seed=1, n=4, trusted="off")
+    assert cell["cell"] == "baseline@s1/n4"
+    assert cell["green"] is True
+    assert cell["crypto_mode"] == "exact"
+    assert cell["rollup"]["commits"]["total"] >= 16
+    assert cell["rollup"]["commits"]["min_node"] >= 4
+    assert cell["rollup"]["verdict"]["ok"] is True
+    assert cell["violations"] == {"safety": [], "liveness": [], "expectations": []}
+    # auto mode stubs crypto at fleet sizes and records it in the cell
+    cell64 = run_matrix_cell("baseline", seed=1, n=64, trusted="auto")
+    assert cell64["crypto_mode"] == "trusted-stub"
+    assert cell64["green"] is True
+    assert cell64["rollup"]["commits"]["min_node"] >= 4
+    with pytest.raises(ValueError):
+        run_matrix_cell("baseline", seed=1, n=4, trusted="sometimes")
+
+
+# --- the tier-1 64-node baseline smoke --------------------------------------
+
+
+def test_fleet_64_node_baseline_smoke_bit_identical():
+    """ISSUE 12 acceptance: a 64-node committee commits under
+    trusted-crypto + the WAN matrix on this box, inside tier-1 budget —
+    and the SAME seed replays bit-identically: fault trace, commit
+    sequences, region map, AND every node's telemetry snapshot ring."""
+    kwargs = dict(
+        n=64,
+        trusted_crypto=True,
+        wan=WanMatrix(),
+        telemetry=TelemetryConfig(interval_s=0.2, ring=64, dump_snapshots=4),
+    )
+    a = run_scenario("baseline", seed=11, **kwargs)
+    assert a["ok"], a["safety_violations"] or a["liveness_violations"]
+    assert a["crypto_mode"] == "trusted-stub"
+    assert a["nodes"] == 64
+    commits = {node: len(c) for node, c in a["commits"].items()}
+    assert len(commits) == 64 and min(commits.values()) >= 4
+    # all four WAN regions are populated (balanced assignment at n=64)
+    assert sorted(set(a["wan_regions"].values())) == sorted(WanMatrix().regions)
+    # crypto demonstrably rode the stub, at fleet scale
+    assert a["metrics"]["chaos.stub_verifies"] > 1_000
+    assert a["metrics"]["wan.cross_region_frames"] > 0
+    b = run_scenario("baseline", seed=11, **kwargs)
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["commits"] == b["commits"]
+    assert a["events"] == b["events"]
+    assert a["wan_regions"] == b["wan_regions"]
+    snaps_a = {n: d["snapshots"] for n, d in a["telemetry"].items()}
+    snaps_b = {n: d["snapshots"] for n, d in b["telemetry"].items()}
+    assert snaps_a == snaps_b
+    # the fleet rollup distils it: 64 nodes, every one at the floor
+    rollup = fleet_rollup(a)
+    assert rollup["nodes"] == 64
+    assert rollup["commits"]["min_node"] >= 4
+    assert rollup["verdict"]["ok"] is True

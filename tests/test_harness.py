@@ -1059,3 +1059,269 @@ def test_log_parser_reconfig_section():
     assert "+ RECONFIG:" in out
     assert "Epoch switches observed: 2 (highest epoch 2 at round 15)" in out
     assert "2 range sync(s), worst start lag 21 rounds, 19 blocks fetched" in out
+
+
+# ---------------------------------------------------------------------------
+# Scenario-matrix runner (tools/chaos_run.py --matrix) + the LogParser
+# MATRIX section (benchmark/logs.py) + the matrix-grid lint
+
+
+def _load_chaos_run():
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "chaos_run.py"
+    )
+    spec = importlib.util.spec_from_file_location("chaos_run", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.chaos
+def test_chaos_matrix_cli_smoke_and_auto_numbering(tmp_path):
+    """Subprocess acceptance: --matrix sweeps the given grid, prints the
+    scrapeable MATRIX lines, auto-numbers CHAOS_MATRIX_rNN.json in the
+    working directory, and a second run diffs against the first (all
+    deltas zero — cells are deterministic per config)."""
+    import json
+    import subprocess
+    import sys
+
+    tool = os.path.join(
+        os.path.dirname(__file__), "..", "tools", "chaos_run.py"
+    )
+    argv = [
+        sys.executable, tool, "--matrix",
+        "--matrix-scenarios", "baseline",
+        "--matrix-seeds", "1",
+        "--matrix-sizes", "4",
+        "--trusted", "on",  # stub even at n=4: the cheap smoke shape
+    ]
+    proc = subprocess.run(
+        argv, capture_output=True, text=True, timeout=300, cwd=tmp_path
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "MATRIX cell baseline@s1/n4 green crypto=trusted-stub" in proc.stdout
+    assert "MATRIX result: 1 green / 0 red of 1 cells" in proc.stdout
+    artifact = json.loads((tmp_path / "CHAOS_MATRIX_r01.json").read_text())
+    assert artifact["kind"] == "chaos_matrix"
+    assert artifact["summary"] == {
+        "cells": 1, "green": 1, "red": 0,
+        "wall_seconds": artifact["summary"]["wall_seconds"],
+    }
+    (cell,) = artifact["cells"]
+    assert cell["cell"] == "baseline@s1/n4"
+    assert cell["rollup"]["verdict"]["ok"] is True
+    assert cell["rollup"]["commits"]["total"] >= 16
+    assert artifact["regression"] == {"baseline": None}
+
+    proc2 = subprocess.run(
+        argv, capture_output=True, text=True, timeout=300, cwd=tmp_path
+    )
+    assert proc2.returncode == 0, proc2.stderr[-2000:]
+    assert "MATRIX worst regression: baseline@s1/n4 commit rate +0.00%" in (
+        proc2.stdout
+    )
+    artifact2 = json.loads((tmp_path / "CHAOS_MATRIX_r02.json").read_text())
+    reg = artifact2["regression"]
+    assert reg["baseline"].endswith("CHAOS_MATRIX_r01.json")
+    assert reg["newly_red"] == [] and reg["newly_green"] == []
+    assert reg["commit_rate_deltas"] == {"baseline@s1/n4": 0.0}
+
+
+@pytest.mark.chaos
+def test_chaos_matrix_regression_rc1_when_green_cell_goes_red(
+    monkeypatch, tmp_path, capsys
+):
+    """The regression contract: a cell the baseline artifact recorded
+    GREEN that comes back RED exits rc 1 (ranked above plain red cells,
+    which are rc 2 without a baseline flip)."""
+    import json
+
+    from hotstuff_tpu.chaos import scenarios as sc
+    from hotstuff_tpu.chaos.plan import FaultPlan, LinkFaults
+
+    chaos_run = _load_chaos_run()
+    rigged = sc.Scenario(
+        name="rigged_red",
+        description="always fails its expectation (test fixture)",
+        plan=lambda: FaultPlan(default_link=LinkFaults(delay=0.01)),
+        duration=3.0,
+        min_commits=1,
+        expect=lambda report, deltas: ["forced red (fixture)"],
+    )
+    monkeypatch.setitem(sc.SCENARIOS, "rigged_red", rigged)
+    monkeypatch.chdir(tmp_path)
+
+    # no baseline: red cells are rc 2
+    out1 = tmp_path / "m1.json"
+    rc = chaos_run.main(
+        [
+            "--matrix", "--matrix-scenarios", "rigged_red",
+            "--matrix-seeds", "1", "--matrix-sizes", "4",
+            "--trusted", "on", "--report", str(out1),
+        ]
+    )
+    assert rc == 2
+    assert "rigged_red@s1/n4 red" in capsys.readouterr().out
+
+    # baseline claims the cell was green: the flip is rc 1 + the
+    # regression line the LogParser scrapes
+    doctored = json.loads(out1.read_text())
+    doctored["cells"][0]["green"] = True
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(doctored))
+    rc = chaos_run.main(
+        [
+            "--matrix", "--matrix-scenarios", "rigged_red",
+            "--matrix-seeds", "1", "--matrix-sizes", "4",
+            "--trusted", "on", "--report", str(tmp_path / "m2.json"),
+            "--baseline", str(baseline),
+        ]
+    )
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "MATRIX regression: rigged_red@s1/n4 went red (was green)" in out
+    report2 = json.loads((tmp_path / "m2.json").read_text())
+    assert report2["regression"]["newly_red"] == ["rigged_red@s1/n4"]
+
+    # unknown grid scenario names are a usage error, not a silent skip
+    assert chaos_run.main(
+        ["--matrix", "--matrix-scenarios", "no_such_cell"]
+    ) == 3
+
+
+def test_chaos_matrix_regression_deltas_unit():
+    """_regression_deltas joins on the stable cell key: verdict flips in
+    both directions, per-cell commit-rate deltas, worst pick."""
+    chaos_run = _load_chaos_run()
+
+    def cell(name, green, rate):
+        return {
+            "cell": name,
+            "green": green,
+            "rollup": {"commits": {"rate_per_s": rate}},
+        }
+
+    baseline = {
+        "cells": [
+            cell("a@s1/n4", True, 10.0),
+            cell("b@s1/n4", False, 5.0),
+            cell("gone@s1/n4", True, 1.0),
+        ]
+    }
+    now = [
+        cell("a@s1/n4", False, 8.0),
+        cell("b@s1/n4", True, 6.0),
+        cell("new@s1/n4", True, 2.0),
+    ]
+    deltas = chaos_run._regression_deltas(now, baseline)
+    assert deltas["newly_red"] == ["a@s1/n4"]
+    assert deltas["newly_green"] == ["b@s1/n4"]
+    assert deltas["commit_rate_deltas"] == {
+        "a@s1/n4": -20.0, "b@s1/n4": 20.0,
+    }
+    assert deltas["worst_commit_rate_delta"] == {
+        "cell": "a@s1/n4", "pct": -20.0,
+    }
+    # baseline cells absent from this run's grid are surfaced, never
+    # silently dropped from the regression chain
+    assert deltas["missing_from_run"] == ["gone@s1/n4"]
+
+
+def test_lint_matrix_flags_unknown_and_committee_pinned_grid(monkeypatch):
+    """The matrix-grid lint: every grid name must resolve in the registry
+    and no grid scenario may pin a committee subset (the size override
+    cannot survive one); today's grid is clean."""
+    from hotstuff_tpu.chaos import scenarios as sc
+
+    lint = _load_lint()
+    assert lint.lint_matrix() == []
+    monkeypatch.setattr(
+        sc, "MATRIX_SCENARIOS", ("baseline", "ghost_cell", "epoch_reconfig")
+    )
+    problems = lint.lint_matrix()
+    assert len(problems) == 2
+    assert any("ghost_cell" in p and "does not resolve" in p for p in problems)
+    assert any(
+        "epoch_reconfig" in p and "committee" in p for p in problems
+    )
+
+
+def test_log_parser_matrix_section():
+    """MATRIX result lines (chaos_run.py --matrix) fold into a
+    '+ MATRIX:' section: cells run/green/red, newly-red regressions, and
+    the worst commit-rate delta. Absent when no matrix ran."""
+    from benchmark.logs import LogParser
+
+    assert "+ MATRIX" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node = NODE_LOG + (
+        "MATRIX cell baseline@s1/n4 green crypto=exact commits=18 "
+        "rate=24.0/s wall=0.5s\n"
+        "MATRIX cell baseline@s1/n64 green crypto=trusted-stub commits=288 "
+        "rate=384.0/s wall=0.6s\n"
+        "MATRIX cell lossy_links@s2/n64 red crypto=trusted-stub commits=100 "
+        "rate=50.0/s wall=3.0s\n"
+        "MATRIX result: 2 green / 1 red of 3 cells\n"
+        "MATRIX regression: lossy_links@s2/n64 went red (was green)\n"
+        "MATRIX worst regression: lossy_links@s2/n64 commit rate -41.18%\n"
+    )
+    p = LogParser([CLIENT_LOG], [node])
+    assert p.matrix_cells == [
+        ("baseline@s1/n4", "green"),
+        ("baseline@s1/n64", "green"),
+        ("lossy_links@s2/n64", "red"),
+    ]
+    assert p.matrix_regressions == ["lossy_links@s2/n64"]
+    assert p.matrix_worst == [("lossy_links@s2/n64", -41.18)]
+    out = p.result()
+    assert "+ MATRIX:" in out
+    assert "Cells: 3 run (2 green, 1 red)" in out
+    assert (
+        "REGRESSION: 1 previously-green cell(s) went red: "
+        "lossy_links@s2/n64" in out
+    )
+    assert (
+        "Worst commit-rate delta vs baseline: lossy_links@s2/n64 -41.18 %"
+        in out
+    )
+
+
+@pytest.mark.chaos
+def test_telemetry_dash_matrix_view(tmp_path, monkeypatch):
+    """The dashboard renders a matrix artifact: one row per cell with
+    verdict/commit-rate/regression markers, --json emits the normalized
+    cells, and a non-matrix JSON is rc 3."""
+    import json
+
+    # isolate baseline auto-discovery from whatever CHAOS_MATRIX_r*.json
+    # the pytest invocation directory happens to hold
+    monkeypatch.chdir(tmp_path)
+    chaos_run = _load_chaos_run()
+    out = tmp_path / "matrix.json"
+    rc = chaos_run.main(
+        [
+            "--matrix", "--matrix-scenarios", "baseline",
+            "--matrix-seeds", "1", "--matrix-sizes", "4",
+            "--trusted", "on", "--report", str(out),
+        ]
+    )
+    assert rc == 0
+    md = _run_dash("--matrix", str(out))
+    assert md.returncode == 0, md.stderr[-2000:]
+    assert "Scenario matrix (1 green / 0 red of 1 cells" in md.stdout
+    assert "| baseline@s1/n4 | trusted-stub | GREEN |" in md.stdout
+    js = _run_dash("--matrix", str(out), "--json")
+    assert js.returncode == 0, js.stderr[-2000:]
+    data = json.loads(js.stdout)
+    assert data["mode"] == "matrix"
+    (rec,) = data["cells"]
+    assert rec["cell"] == "baseline@s1/n4" and rec["green"] is True
+    assert rec["commits"] >= 16 and rec["truncated"] is False
+
+    not_matrix = tmp_path / "plain.json"
+    not_matrix.write_text(json.dumps({"ok": True}))
+    bad = _run_dash("--matrix", str(not_matrix))
+    assert bad.returncode == 3
+    assert "chaos_matrix" in bad.stderr

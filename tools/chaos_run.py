@@ -14,28 +14,263 @@ anomaly-watchdog triggers/dumps, and an overall `ok` flag. The same
 failing run's seed IS its reproducer, and a failed scenario is
 diagnosable from the report alone (tools/metrics_report.py renders it).
 
-Exit codes: 0 = every invariant and expectation held; 2 = violations
-(report still written); 3 = usage error.
+Scenario-matrix mode (the fleet observatory's regression harness):
+
+    python tools/chaos_run.py --matrix                 # default grid
+    python tools/chaos_run.py --matrix \
+        --matrix-scenarios baseline,lossy_links \
+        --matrix-seeds 1,2 --matrix-sizes 4,64,100 --jobs 2
+
+sweeps scenarios x seeds x committee sizes (cells at/above 16 nodes run
+the trusted-crypto stub — chaos/trusted_crypto.py — and every cell gets
+the seeded WAN latency matrix plus per-node telemetry planes), merges
+each node's telemetry into fleet-wide rollups (cross-node lane-percentile
+merge, worst-node occupancy, commit rate, safety/liveness verdict per
+cell — utils/telemetry.fleet_rollup), and writes ONE consolidated
+CHAOS_MATRIX_rN.json (auto-numbered next to the previous artifact unless
+--report names it). When a previous matrix artifact exists (newest
+CHAOS_MATRIX_r*.json, or --baseline), the run also emits regression
+deltas: cells that flipped green->red and the worst per-cell commit-rate
+delta. `tools/telemetry_dash.py --matrix` renders the artifact.
+
+Exit codes: 0 = every invariant and expectation held; 2 = violations /
+red cells (report still written); 3 = usage error. Matrix mode adds
+rc 1 = a previously-green cell went RED against the baseline artifact —
+the scale-regression signal, ranked above plain red cells so CI treats a
+regression differently from a grid that was never green.
 
 Dependency-free on purpose: no jax, no `cryptography` — signatures ride
-the pure-python RFC 8032 implementation (hotstuff_tpu/crypto/pysigner.py).
+the pure-python RFC 8032 implementation (hotstuff_tpu/crypto/pysigner.py)
+or, at fleet sizes, its keyed-hash stub scheme.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import logging
 import os
+import re
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from hotstuff_tpu.chaos.scenarios import (  # noqa: E402
+    MATRIX_SCENARIOS,
+    MATRIX_SEEDS,
+    MATRIX_SIZES,
     SCENARIOS,
     SHORT_SCENARIOS,
+    run_matrix_cell,
     run_scenario,
 )
+from hotstuff_tpu.utils import metrics  # noqa: E402
+
+_M_CELLS = metrics.counter("matrix.cells")
+_M_GREEN = metrics.counter("matrix.cells_green")
+_M_RED = metrics.counter("matrix.cells_red")
+_M_REGRESSIONS = metrics.counter("matrix.regressions")
+
+
+def _run_cell(spec: dict) -> dict:
+    """Top-level worker for --jobs process pools (must be picklable)."""
+    return run_matrix_cell(**spec)
+
+
+def _matrix_revisions(directory: str) -> list[tuple[int, str]]:
+    """Committed CHAOS_MATRIX_r<NN>.json artifacts in `directory` as
+    sorted (revision, path) pairs — the single discovery scan both the
+    auto-numberer and the baseline picker fold over."""
+    out = []
+    for path in glob.glob(os.path.join(directory, "CHAOS_MATRIX_r*.json")):
+        m = re.fullmatch(r"CHAOS_MATRIX_r(\d+)\.json", os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def _next_matrix_path(directory: str) -> str:
+    """Auto-numbering: one past the highest committed revision."""
+    revs = _matrix_revisions(directory)
+    best = revs[-1][0] if revs else 0
+    return os.path.join(directory, f"CHAOS_MATRIX_r{best + 1:02d}.json")
+
+
+def _latest_matrix_baseline(directory: str, exclude: str) -> str | None:
+    """Newest CHAOS_MATRIX_r*.json by revision number, skipping the file
+    this run is about to write."""
+    for _rev, path in reversed(_matrix_revisions(directory)):
+        if os.path.abspath(path) != os.path.abspath(exclude):
+            return path
+    return None
+
+
+def _regression_deltas(cells: list[dict], baseline: dict) -> dict:
+    """Per-cell deltas against a previous matrix artifact, joined on the
+    stable cell key. Verdict flips are the hard signal (rc 1 for
+    green->red); commit-rate deltas are the soft trend — deterministic
+    per cell config, so a nonzero delta means the CODE changed the run,
+    not the weather. Baseline cells ABSENT from this run's grid are
+    surfaced in `missing_from_run`: a reduced-grid sweep auto-numbered
+    into the rNN chain would otherwise silently drop those cells'
+    guarantees from every later diff."""
+    prev = {c["cell"]: c for c in baseline.get("cells", ())}
+    now_keys = {c["cell"] for c in cells}
+    newly_red, newly_green, rate_deltas = [], [], {}
+    for cell in cells:
+        p = prev.get(cell["cell"])
+        if p is None:
+            continue
+        if p.get("green") and not cell["green"]:
+            newly_red.append(cell["cell"])
+        elif not p.get("green") and cell["green"]:
+            newly_green.append(cell["cell"])
+        prev_rate = (p.get("rollup") or {}).get("commits", {}).get("rate_per_s")
+        now_rate = cell["rollup"]["commits"]["rate_per_s"]
+        if prev_rate:
+            rate_deltas[cell["cell"]] = round(
+                100.0 * (now_rate - prev_rate) / prev_rate, 2
+            )
+    worst = (
+        min(rate_deltas.items(), key=lambda kv: kv[1]) if rate_deltas else None
+    )
+    return {
+        "newly_red": newly_red,
+        "newly_green": newly_green,
+        "commit_rate_deltas": rate_deltas,
+        "worst_commit_rate_delta": (
+            {"cell": worst[0], "pct": worst[1]} if worst else None
+        ),
+        "missing_from_run": sorted(set(prev) - now_keys),
+    }
+
+
+def run_matrix(args) -> int:
+    names = (
+        [s.strip() for s in args.matrix_scenarios.split(",") if s.strip()]
+        if args.matrix_scenarios
+        else list(MATRIX_SCENARIOS)
+    )
+    unknown = [s for s in names if s not in SCENARIOS]
+    if unknown:
+        print(
+            f"unknown matrix scenario(s) {unknown}; --list shows the library",
+            file=sys.stderr,
+        )
+        return 3
+    seeds = (
+        [int(s) for s in args.matrix_seeds.split(",") if s.strip()]
+        if args.matrix_seeds
+        else list(MATRIX_SEEDS)
+    )
+    sizes = (
+        [int(s) for s in args.matrix_sizes.split(",") if s.strip()]
+        if args.matrix_sizes
+        else list(MATRIX_SIZES)
+    )
+    specs = [
+        {"scenario": s, "seed": seed, "n": n, "trusted": args.trusted}
+        for s in names
+        for seed in seeds
+        for n in sizes
+    ]
+    out_path = args.report or _next_matrix_path(os.getcwd())
+    # Resolve and load the baseline BEFORE the sweep: a typoed --baseline
+    # or a truncated auto-discovered artifact must fail in milliseconds,
+    # not after minutes of 64-node cells whose results would be lost.
+    baseline_path = args.baseline or _latest_matrix_baseline(
+        os.getcwd(), exclude=out_path
+    )
+    baseline_data = None
+    if baseline_path:
+        try:
+            with open(baseline_path) as f:
+                baseline_data = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"baseline {baseline_path}: {e}", file=sys.stderr)
+            return 3
+    t0 = time.perf_counter()
+    if args.jobs > 1:
+        # Process workers double as per-cell isolation (fresh metrics
+        # registry each); serial cells share one process and rely on
+        # run_scenario's delta accounting, same as the tier-1 sweep.
+        import concurrent.futures as cf
+
+        with cf.ProcessPoolExecutor(max_workers=args.jobs) as pool:
+            cells = list(pool.map(_run_cell, specs))
+    else:
+        cells = [_run_cell(spec) for spec in specs]
+    wall = time.perf_counter() - t0
+
+    green = sum(1 for c in cells if c["green"])
+    red = len(cells) - green
+    _M_CELLS.inc(len(cells))
+    _M_GREEN.inc(green)
+    _M_RED.inc(red)
+
+    regression = {"baseline": baseline_path}
+    if baseline_data is not None:
+        regression.update(_regression_deltas(cells, baseline_data))
+    newly_red = regression.get("newly_red", [])
+    _M_REGRESSIONS.inc(len(newly_red))
+
+    for c in cells:
+        rollup = c["rollup"]
+        print(
+            f"MATRIX cell {c['cell']} {'green' if c['green'] else 'red'} "
+            f"crypto={c['crypto_mode']} commits={rollup['commits']['total']} "
+            f"rate={rollup['commits']['rate_per_s']}/s "
+            f"wall={c['wall_seconds']}s"
+        )
+    print(f"MATRIX result: {green} green / {red} red of {len(cells)} cells")
+    for cell in newly_red:
+        print(f"MATRIX regression: {cell} went red (was green)")
+    missing = regression.get("missing_from_run", [])
+    if missing:
+        # A reduced grid is fine for a fast loop, but its artifact joins
+        # the auto-discovered baseline chain — say loudly which baseline
+        # cells this run carries NO verdict for.
+        print(
+            f"MATRIX warning: {len(missing)} baseline cell(s) not in this "
+            f"run's grid (their green guarantees are untracked here): "
+            + ", ".join(missing)
+        )
+    worst = regression.get("worst_commit_rate_delta")
+    if baseline_path:
+        print(
+            "MATRIX worst regression: "
+            + (f"{worst['cell']} commit rate {worst['pct']:+.2f}%"
+               if worst else "none")
+        )
+
+    artifact = {
+        "v": 1,
+        "kind": "chaos_matrix",
+        "generated_wall": time.time(),
+        "grid": {
+            "scenarios": names,
+            "seeds": seeds,
+            "sizes": sizes,
+            "trusted": args.trusted,
+        },
+        "cells": cells,
+        "summary": {
+            "cells": len(cells),
+            "green": green,
+            "red": red,
+            "wall_seconds": round(wall, 3),
+        },
+        "regression": regression,
+    }
+    with open(out_path, "w") as f:
+        json.dump(artifact, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"MATRIX artifact written to {out_path}")
+    if newly_red:
+        return 1
+    return 2 if red else 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -54,6 +289,49 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--list", action="store_true", help="list scenarios and exit"
     )
+    parser.add_argument(
+        "--matrix",
+        action="store_true",
+        help="scenario-matrix mode: sweep scenarios x seeds x committee "
+        "sizes and write one consolidated CHAOS_MATRIX_rN.json with "
+        "fleet rollups + regression deltas",
+    )
+    parser.add_argument(
+        "--matrix-scenarios",
+        default=None,
+        help=f"comma-separated grid scenarios (default {','.join(MATRIX_SCENARIOS)})",
+    )
+    parser.add_argument(
+        "--matrix-seeds",
+        default=None,
+        help=f"comma-separated seeds (default {','.join(map(str, MATRIX_SEEDS))})",
+    )
+    parser.add_argument(
+        "--matrix-sizes",
+        default=None,
+        help="comma-separated committee sizes "
+        f"(default {','.join(map(str, MATRIX_SIZES))})",
+    )
+    parser.add_argument(
+        "--trusted",
+        choices=("auto", "on", "off"),
+        default="auto",
+        help="matrix trusted-crypto mode: auto stubs signatures from 16 "
+        "nodes up (chaos/trusted_crypto.py trust model applies)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=1,
+        help="matrix worker processes (default 1 = serial; keep 1 on "
+        "single-core boxes)",
+    )
+    parser.add_argument(
+        "--baseline",
+        default=None,
+        help="previous matrix artifact for regression deltas (default: "
+        "newest CHAOS_MATRIX_r*.json in the working directory)",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -61,6 +339,12 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.DEBUG if args.verbose else logging.WARNING,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+
+    if args.matrix:
+        if args.jobs < 1:
+            print("--jobs must be >= 1", file=sys.stderr)
+            return 3
+        return run_matrix(args)
 
     if args.list:
         for name in sorted(SCENARIOS):

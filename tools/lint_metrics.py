@@ -55,6 +55,14 @@ The scenario-registry lint (chaos/scenarios.py) fails rc 1 when:
     construction, but a `slow=True` scenario must be named (string
     literal) somewhere under tests/ or nothing ever runs it.
 
+The matrix-grid lint (chaos/scenarios.py MATRIX_SCENARIOS) fails rc 1
+when a grid scenario name does not resolve in the scenario registry
+(the matrix runner would rc-3 at sweep time, long after the rename that
+broke it), or when a grid scenario pins `committee=` indices — grid
+cells override the committee size, which a pinned subset cannot survive
+(run_scenario refuses the override at runtime; the lint catches it at
+review time).
+
 `utils/telemetry.py`, `ops/timeline.py` and `ops/pipeline.py` must stay
 importable without jax (like DeviceScheduler) — this lint runs on
 jax-less hosts.
@@ -232,6 +240,31 @@ def lint_scenarios(tests_dir: str | None = None) -> list[str]:
     return problems
 
 
+def lint_matrix() -> list[str]:
+    """Every matrix-grid scenario must resolve in the registry and be
+    committee-size-invariant (no pinned committee subset) — the grid is
+    the regression harness for every scale claim, so a silently-dropped
+    cell is a silently-dropped guarantee."""
+    from hotstuff_tpu.chaos.scenarios import MATRIX_SCENARIOS, SCENARIOS
+
+    problems: list[str] = []
+    for name in MATRIX_SCENARIOS:
+        scenario = SCENARIOS.get(name)
+        if scenario is None:
+            problems.append(
+                f"matrix-grid scenario {name!r} does not resolve in the "
+                "chaos scenario registry (chaos_run.py --matrix would "
+                "reject the default grid)"
+            )
+        elif scenario.committee is not None:
+            problems.append(
+                f"matrix-grid scenario {name!r} pins committee indices "
+                f"{scenario.committee} — grid cells override the "
+                "committee size, which a pinned subset cannot survive"
+            )
+    return problems
+
+
 def run(root: str) -> list[str]:
     from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
     from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
@@ -256,6 +289,7 @@ def run(root: str) -> list[str]:
         + lint_telemetry()
         + lint_pipeline()
         + lint_scenarios()
+        + lint_matrix()
     )
 
 

@@ -10,6 +10,11 @@ device occupancy, and SLO burn alerts — one renderer for both sources.
     # per-node telemetry section (tools/chaos_run.py --report)
     python tools/telemetry_dash.py --report chaos.json
 
+    # scenario-matrix artifact (tools/chaos_run.py --matrix): one row per
+    # cell — verdict, commit rate, fleet lane p99s, worst-node occupancy,
+    # regression markers against the artifact's recorded baseline
+    python tools/telemetry_dash.py --matrix CHAOS_MATRIX_r01.json
+
     # machine-readable (same normalized records either way)
     python tools/telemetry_dash.py --report chaos.json --json
 
@@ -116,6 +121,81 @@ def records_from_poll(targets: list[str], timeout: float) -> tuple[list[dict], l
     return records, errors
 
 
+def cell_record(cell: dict, regression: dict) -> dict:
+    """Normalize one matrix cell (+ the artifact's regression section)
+    into the grid-row record: the cell's identity/verdict, the fleet
+    rollup's headline numbers, and this cell's regression markers."""
+    rollup = cell.get("rollup") or {}
+    commits = rollup.get("commits") or {}
+    lanes = rollup.get("lanes") or {}
+    occ = rollup.get("occupancy") or {}
+    alerts = rollup.get("alerts") or {}
+    name = cell.get("cell", "?")
+    return {
+        "cell": name,
+        "scenario": cell.get("scenario"),
+        "seed": cell.get("seed"),
+        "n": cell.get("n"),
+        "crypto": cell.get("crypto_mode", "?"),
+        "green": bool(cell.get("green")),
+        "commits": int(commits.get("total") or 0),
+        "commit_rate": float(commits.get("rate_per_s") or 0.0),
+        "consensus_p99_ms": (lanes.get("consensus") or {}).get("p99_ms"),
+        "worst_occupancy": occ.get("worst"),
+        "alerts_fired": int(alerts.get("fired") or 0),
+        "truncated": bool(rollup.get("fault_trace_truncated")),
+        "newly_red": name in (regression.get("newly_red") or ()),
+        "rate_delta_pct": (regression.get("commit_rate_deltas") or {}).get(name),
+        "violations": cell.get("violations") or {},
+    }
+
+
+def render_matrix(artifact: dict) -> str:
+    regression = artifact.get("regression") or {}
+    records = [
+        cell_record(c, regression) for c in artifact.get("cells") or ()
+    ]
+    summary = artifact.get("summary") or {}
+    lines = [
+        f"### Scenario matrix ({summary.get('green', '?')} green / "
+        f"{summary.get('red', '?')} red of {summary.get('cells', '?')} "
+        f"cells; baseline: {regression.get('baseline') or '-'})\n",
+        "| cell | crypto | verdict | commits | commit/s | rate Δ | "
+        "consensus p99 (ms) | worst occupancy | alerts | trace |",
+        "|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in records:
+        verdict = "GREEN" if r["green"] else "RED"
+        if r["newly_red"]:
+            verdict = "RED (regression)"
+        delta = (
+            f"{r['rate_delta_pct']:+.1f}%"
+            if isinstance(r["rate_delta_pct"], (int, float))
+            else "-"
+        )
+        p99 = (
+            f"{r['consensus_p99_ms']:.1f}"
+            if isinstance(r["consensus_p99_ms"], (int, float))
+            else "-"
+        )
+        lines.append(
+            f"| {r['cell']} | {r['crypto']} | {verdict} | {r['commits']} "
+            f"| {r['commit_rate']:.1f} | {delta} | {p99} "
+            f"| {_fmt_pct(r['worst_occupancy'])} | {r['alerts_fired']} "
+            f"| {'TRUNCATED' if r['truncated'] else 'full'} |"
+        )
+    problems = [
+        f"- {r['cell']}: {kind}: {msg}"
+        for r in records
+        if not r["green"]
+        for kind, msgs in sorted(r["violations"].items())
+        for msg in msgs
+    ]
+    if problems:
+        lines += ["", "#### Red-cell violations", *problems]
+    return "\n".join(lines)
+
+
 def _fmt_pct(v) -> str:
     return f"{v * 100:.1f}%" if isinstance(v, (int, float)) else "-"
 
@@ -168,6 +248,12 @@ def main(argv: list[str] | None = None) -> int:
         default=None,
         help="chaos report JSON with an embedded telemetry section (offline)",
     )
+    src.add_argument(
+        "--matrix",
+        default=None,
+        help="scenario-matrix artifact (tools/chaos_run.py --matrix) — "
+        "renders the per-cell grid with regression markers",
+    )
     ap.add_argument(
         "--json",
         action="store_true",
@@ -178,6 +264,40 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     errors: list[str] = []
+    if args.matrix:
+        try:
+            with open(args.matrix) as f:
+                artifact = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"{args.matrix}: {e}", file=sys.stderr)
+            return 3
+        if artifact.get("kind") != "chaos_matrix":
+            print(
+                f"{args.matrix}: not a scenario-matrix artifact "
+                "(expected kind=chaos_matrix from chaos_run.py --matrix)",
+                file=sys.stderr,
+            )
+            return 3
+        regression = artifact.get("regression") or {}
+        if args.json:
+            print(
+                json.dumps(
+                    {
+                        "mode": "matrix",
+                        "cells": [
+                            cell_record(c, regression)
+                            for c in artifact.get("cells") or ()
+                        ],
+                        "summary": artifact.get("summary") or {},
+                        "regression": regression,
+                    },
+                    indent=2,
+                    sort_keys=True,
+                )
+            )
+        else:
+            print(render_matrix(artifact))
+        return 0
     if args.poll:
         mode = "live"
         records, errors = records_from_poll(
