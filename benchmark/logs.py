@@ -169,6 +169,21 @@ def _parse_node(text: str) -> dict:
     out["range_blocks"] = sum(
         int(n) for n in _search_all(r"Range sync fetched (\d+) blocks", text)
     )
+    # Aggregation-overlay lines (consensus/overlay.py + core.py): partial
+    # bundles that completed a certificate, and gossip fallbacks fired
+    # when a round stayed stalled past the fallback window.
+    out["agg_quorums"] = [
+        (kind, int(rnd), int(entries))
+        for kind, rnd, entries in _search_all(
+            r"Agg bundle quorum: (QC|TC) round (\d+) from (\d+) entries", text
+        )
+    ]
+    out["agg_fallbacks"] = [
+        (int(rnd), int(entries), int(peers))
+        for rnd, entries, peers in _search_all(
+            r"Agg fallback round (\d+): (\d+) entries to (\d+) peers", text
+        )
+    ]
     # Scenario-matrix result lines (tools/chaos_run.py --matrix): per-cell
     # verdicts, green->red regressions against the committed baseline
     # artifact, and the worst per-cell commit-rate delta.
@@ -286,6 +301,10 @@ class LogParser:
         self.epoch_switches: list[tuple[int, int]] = []
         self.range_lags: list[int] = []
         self.range_blocks = 0
+        # Aggregation-overlay scrapes: (kind, round, entries) per bundle
+        # quorum and (round, entries, peers) per gossip fallback.
+        self.agg_quorums: list[tuple[str, int, int]] = []
+        self.agg_fallbacks: list[tuple[int, int, int]] = []
         # Scenario-matrix lines: (cell, green|red) verdicts, newly-red
         # cell names, and (cell, pct) worst commit-rate deltas.
         self.matrix_cells: list[tuple[str, str]] = []
@@ -320,6 +339,8 @@ class LogParser:
             self.epoch_switches.extend(r.get("epoch_switches", []))
             self.range_lags.extend(r.get("range_lags", []))
             self.range_blocks += r.get("range_blocks", 0)
+            self.agg_quorums.extend(r.get("agg_quorums", []))
+            self.agg_fallbacks.extend(r.get("agg_fallbacks", []))
             self.matrix_cells.extend(r.get("matrix_cells", []))
             self.matrix_regressions.extend(r.get("matrix_regressions", []))
             self.matrix_worst.extend(r.get("matrix_worst", []))
@@ -545,6 +566,24 @@ class LogParser:
                     f" Worst commit-rate delta vs baseline: {cell}"
                     f" {pct:+.2f} %\n"
                 )
+        agg = ""
+        if self.agg_quorums or self.agg_fallbacks:
+            agg = " + AGG:\n"
+            if self.agg_quorums:
+                qcs = sum(1 for k, _r, _n in self.agg_quorums if k == "QC")
+                tcs = len(self.agg_quorums) - qcs
+                entries = sum(n for _k, _r, n in self.agg_quorums)
+                agg += (
+                    f" Bundle quorums: {len(self.agg_quorums)}"
+                    f" ({qcs} QC, {tcs} TC) from {entries:,} merged entries\n"
+                )
+            if self.agg_fallbacks:
+                gossiped = sum(e for _r, e, _p in self.agg_fallbacks)
+                frames = sum(p for _r, _e, p in self.agg_fallbacks)
+                agg += (
+                    f" Fallbacks: {len(self.agg_fallbacks)}"
+                    f" ({gossiped:,} entries gossiped over {frames:,} frames)\n"
+                )
         reconfig = ""
         if self.epoch_switches or self.range_lags:
             reconfig = " + RECONFIG:\n"
@@ -599,6 +638,7 @@ class LogParser:
             + ingress
             + telemetry
             + matrix
+            + agg
             + reconfig
             + mtr
             + "-----------------------------------------\n"

@@ -20,6 +20,7 @@ hundred-node fleets runnable on one box (see its trust model).
 
 from .byzantine import (
     AdversaryPolicy,
+    BundlePoisoner,
     Equivocator,
     SigForger,
     StaleReplayer,
@@ -50,6 +51,7 @@ from .vtime import VirtualTimeLoop
 
 __all__ = [
     "AdversaryPolicy",
+    "BundlePoisoner",
     "ChaosOrchestrator",
     "CrashWindow",
     "DelayedBoot",

@@ -24,7 +24,9 @@ from ..consensus.messages import (
     TC,
     Block,
     Timeout,
+    TimeoutBundle,
     Vote,
+    VoteBundle,
     decode_consensus_message,
     encode_consensus_message,
 )
@@ -177,3 +179,76 @@ class VoteWithholder(AdversaryPolicy):
             _M_WITHHELD.inc()
             return []
         return None
+
+
+class BundlePoisoner(AdversaryPolicy):
+    """Byzantine aggregator for the overlay plane (consensus/overlay.py):
+    POISONS every outbound partial bundle with a forged entry claiming an
+    honest authority (garbage signature — it must reject alone, without
+    suppressing the honest entries it rides beside), and WITHHOLDS a
+    fraction of the bundles it should have forwarded up the tree (the
+    silent-aggregator shape the gossip fallback exists to bound). The
+    node legitimately signs its own entries — the attack is on the
+    aggregation relay, not the signature scheme.
+
+    Deterministic by COUNT, not probability: every WITHHOLD_EVERY-th
+    bundle is dropped, every other one is poisoned — a short run (the
+    tier-1 sweep early-stops on its commit floor) still exercises both
+    behaviours as soon as a handful of bundles flow."""
+
+    WITHHOLD_EVERY = 3
+
+    def __init__(self, node, seed, committee, rng) -> None:
+        super().__init__(node, seed, committee, rng)
+        self.forged: list[tuple[bytes, PublicKey, Signature]] = []
+        self._bundles_seen = 0
+
+    def on_send(self, src: int, dst: int, data: bytes):
+        from ..consensus.messages import _timeout_digest, _vote_digest
+
+        msg = self._decode(data)
+        if not isinstance(msg, (VoteBundle, TimeoutBundle)):
+            return None
+        self._bundles_seen += 1
+        if self._bundles_seen % self.WITHHOLD_EVERY == 0:
+            _M_WITHHELD.inc()
+            return []
+        author = self.names[(self.node + 1) % len(self.names)]
+        sig = Signature(self.rng.randbytes(64))
+        if isinstance(msg, VoteBundle):
+            self.forged.append(
+                (_vote_digest(msg.hash, msg.round).data, author, sig)
+            )
+            _M_FORGED_VOTES.inc()
+            poisoned = VoteBundle(
+                msg.round, msg.hash, msg.votes + ((author, sig),)
+            )
+        else:
+            # Two attack classes per timeout bundle: (a) a garbage
+            # signature under an honest authority (dies in signature
+            # verification), and (b) the TC-poisoning shape
+            # overlay.filter_backed exists for — this node's OWN entry
+            # re-signed with a LEGITIMATE signature over an absurd
+            # high_qc_round claim the carried QC cannot back. Honest
+            # receivers must drop (b) unmerged (agg.invalid_entries), or
+            # any TC including it would fail every future proposal's
+            # justification check: permanent liveness loss.
+            fake_hqr = msg.round + 1_000_000
+            fake_sig = Signature(
+                pysigner.sign(
+                    self.seed, _timeout_digest(msg.round, fake_hqr).data
+                )
+            )
+            entries = tuple(
+                (self.pk, fake_sig, fake_hqr) if pk == self.pk else (pk, s, hqr)
+                for pk, s, hqr in msg.timeouts
+            )
+            hqr = msg.high_qc.round
+            self.forged.append(
+                (_timeout_digest(msg.round, hqr).data, author, sig)
+            )
+            _M_FORGED_TIMEOUTS.inc()
+            poisoned = TimeoutBundle(
+                msg.round, msg.high_qc, entries + ((author, sig, hqr),)
+            )
+        return [encode_consensus_message(poisoned)]

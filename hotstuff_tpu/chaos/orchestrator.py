@@ -217,6 +217,19 @@ class ChaosOrchestrator:
         )
         self.safety = SafetyChecker(self.committee)
         self.liveness = LivenessChecker()
+        # WAN region labels for the aggregation overlay's region-aware
+        # tree (consensus/overlay.py): the SAME seed-derived map the
+        # transport charges latency by, so the tree's intra-region edges
+        # really are the cheap ones. Built once — it is invariant for
+        # the run (every boot/restart shares it).
+        self.overlay_regions = (
+            {
+                self.keys[j][0]: region
+                for j, region in enumerate(self.transport.regions)
+            }
+            if self.transport.regions
+            else None
+        )
         self.honest = [i for i in range(n) if i not in self.byzantine]
         self.ingress = ingress
         self.ingress_drivers: list[tuple[int, object]] = []  # (node, loadgen)
@@ -304,6 +317,7 @@ class ChaosOrchestrator:
                     verification_service=node.service,
                     epoch_manager=node.epochs,
                     listen_address=("127.0.0.1", BASE_PORT + i),
+                    overlay_regions=self.overlay_regions,
                 )
                 spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
         finally:

@@ -22,9 +22,22 @@ from ..ingress.loadgen import ArrivalCurve, IngressLoad
 from ..utils import metrics
 from ..utils.telemetry import TelemetryConfig
 from . import vtime
-from .byzantine import Equivocator, SigForger, StaleReplayer, VoteWithholder
+from .byzantine import (
+    BundlePoisoner,
+    Equivocator,
+    SigForger,
+    StaleReplayer,
+    VoteWithholder,
+)
 from .orchestrator import BulkFlood, ChaosOrchestrator, ReconfigDirective
-from .plan import CrashWindow, DelayedBoot, FaultPlan, LinkFaults, Partition
+from .plan import (
+    CrashWindow,
+    DelayedBoot,
+    FaultPlan,
+    LinkFaults,
+    Partition,
+    WanMatrix,
+)
 
 # Bounds on one scenario run. VIRTUAL_TIMEOUT_S catches a stop condition
 # that never fires (virtual time races ahead forever); WALL_TIMEOUT_S is a
@@ -51,6 +64,12 @@ class Scenario:
     description: str
     n: int = 4
     plan: Callable[[], FaultPlan] = FaultPlan
+    # Size-parameterized plan factory (receives the EFFECTIVE committee
+    # size, after any matrix `n` override): the way a grid scenario
+    # expresses faults that must scale with n — e.g. the timeout_storm's
+    # half|half no-quorum partition — without pinning node indices.
+    # Takes precedence over `plan` when set.
+    plan_n: Callable[[int], FaultPlan] | None = None
     byzantine: dict[int, object] = field(default_factory=dict)
     parameters: Callable[[], Parameters] = _params
     duration: float = 30.0  # virtual seconds (upper bound)
@@ -746,6 +765,283 @@ _register(
     )
 )
 
+# ---------------------------------------------------------------------------
+# Aggregation-overlay scenarios (ISSUE 13 / ROADMAP item 2): the region-aware
+# vote/timeout aggregation tree (consensus/overlay.py), its failure modes, and
+# the timeout_storm matrix cells that pin the O(n²) -> O(n·fanout) win.
+
+# The storm window: a half|half partition leaves NO quorum on either side,
+# so every round inside it stalls to the pacemaker on every node — the
+# deterministic, committee-size-invariant timeout storm (the organic
+# version was the 64-node lossy@seed2 multi-round stall, CHAOS_MATRIX_r01).
+_STORM_WINDOW = (1.0, 5.0)
+
+# Overlay bound on timeout-plane frames per LOCAL TIMEOUT event: one
+# upward bundle + at most `agg_fanout` gossip-fallback frames + the
+# bounded merged re-forwards, amortized over the fleet's timeout events.
+# O(fanout), committee-size-free — the legacy all-to-all plane pays
+# exactly n-1 per event (frames-per-stalled-round = n times these).
+AGG_STORM_FRAMES_PER_TIMEOUT = 10.0
+
+
+def _agg_params(timeout_ms: int = 1_000) -> Parameters:
+    return Parameters(
+        timeout_delay=timeout_ms,
+        sync_retry_delay=1_000,
+        timeout_backoff=2.0,
+        max_timeout_delay=8_000,
+        aggregation_overlay=True,
+        agg_fanout=4,
+        agg_hold_ms=40,
+        # Below the 1 s pacemaker: a genuinely stalled round (dead
+        # aggregator, partition) always reaches the gossip fallback
+        # before the next local timeout re-arms it.
+        agg_fallback_ms=400,
+    )
+
+
+def _storm_plan(n: int) -> FaultPlan:
+    half = max(1, n // 2)
+    return FaultPlan(
+        default_link=LinkFaults(drop=0.03, delay=0.02, jitter=0.01),
+        partitions=[
+            Partition(
+                start=_STORM_WINDOW[0],
+                end=_STORM_WINDOW[1],
+                groups=(tuple(range(half)), tuple(range(half, n))),
+            )
+        ],
+        # Regions always present: the tree's region-aware placement (and
+        # the wan.cross_region_frames accounting) is part of what the
+        # storm cells pin.
+        wan=WanMatrix(),
+    )
+
+
+def _storm_metrics(deltas: dict) -> tuple[int, int]:
+    return (
+        deltas.get("consensus.timeouts", 0),
+        deltas.get("agg.timeout_frames", 0),
+    )
+
+
+def _expect_timeout_storm(report: dict, deltas: dict) -> list[str]:
+    n = report["nodes"]
+    problems = _expect_counter(deltas, "chaos.partition_drops")
+    timeouts, frames = _storm_metrics(deltas)
+    if timeouts < n:
+        problems.append(
+            f"storm never fired: {timeouts} local timeouts across {n} nodes"
+        )
+        return problems
+    fpt = frames / timeouts
+    if fpt > AGG_STORM_FRAMES_PER_TIMEOUT:
+        problems.append(
+            f"timeout-plane frames per local timeout {fpt:.1f} exceeds the "
+            f"overlay bound {AGG_STORM_FRAMES_PER_TIMEOUT} — the O(n) "
+            "per-event storm is back"
+        )
+    problems += _expect_counter(deltas, "agg.bundles_sent")
+    # No quorum exists inside the window, so every armed fallback fires:
+    # the crashed-aggregator degradation path is structurally exercised.
+    problems += _expect_counter(deltas, "agg.fallbacks")
+    return problems
+
+
+def _expect_timeout_storm_legacy(report: dict, deltas: dict) -> list[str]:
+    n = report["nodes"]
+    problems = _expect_counter(deltas, "chaos.partition_drops")
+    timeouts, frames = _storm_metrics(deltas)
+    if timeouts < n:
+        problems.append(
+            f"storm never fired: {timeouts} local timeouts across {n} nodes"
+        )
+        return problems
+    fpt = frames / timeouts
+    if fpt < 0.8 * (n - 1):
+        problems.append(
+            f"legacy baseline frames per timeout {fpt:.1f} is below "
+            f"0.8*(n-1)={0.8 * (n - 1):.1f} — the committed baseline is "
+            "not measuring the all-to-all storm"
+        )
+    if deltas.get("agg.bundles_sent", 0):
+        problems.append("overlay bundles observed in the legacy cell")
+    return problems
+
+
+_register(
+    Scenario(
+        name="timeout_storm",
+        description="Half|half no-quorum partition stalls every round in "
+        "[1,5) on every node — the deterministic O(n²) timeout storm — "
+        "with the aggregation overlay ON: timeouts merge up the "
+        "region-aware tree as partial bundles (one frame per node per "
+        "event plus bounded gossip fallback), frames-per-timeout stays "
+        "O(fanout) regardless of committee size, and the fleet heals "
+        "cleanly after the window.",
+        plan_n=_storm_plan,
+        parameters=_agg_params,
+        duration=30.0,
+        min_commits=4,
+        heal_t=_STORM_WINDOW[1],
+        expect=_expect_timeout_storm,
+    )
+)
+
+_register(
+    Scenario(
+        name="timeout_storm_legacy",
+        description="The SAME storm with the overlay OFF — the committed "
+        "pre-overlay baseline cell: every node broadcasts every Timeout "
+        "(n-1 frames per local timeout, O(n²) per stalled round), the "
+        "number the timeout_storm cells are diffed against in "
+        "CHAOS_MATRIX_rN.json.",
+        plan_n=_storm_plan,
+        duration=30.0,
+        min_commits=4,
+        heal_t=_STORM_WINDOW[1],
+        expect=_expect_timeout_storm_legacy,
+        # Matrix-only: the baseline number is pinned by the committed
+        # artifact (and the slow-tier test), not the tier-1 sweep.
+        slow=True,
+    )
+)
+
+
+def _expect_agg_crash(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "chaos.crashes")
+    problems += _expect_counter(deltas, "chaos.restarts")
+    problems += _expect_counter(deltas, "agg.bundles_sent")
+    problems += _expect_counter(deltas, "agg.entries_merged")
+    problems += _expect_counter(deltas, "consensus.timeouts")
+    # The crashed node's leader/aggregator rounds stall past
+    # agg_fallback_ms, so the bounded gossip fallback must engage —
+    # degradation, not silence.
+    problems += _expect_counter(deltas, "agg.fallbacks")
+    return problems
+
+
+_register(
+    Scenario(
+        name="agg_collector_crash",
+        description="An overlay aggregator crashes mid-run (node 1 down "
+        "t=1..6 of a 7-node committee): rounds where it was the leader, "
+        "a subtree parent, or the timeout collector stall to the "
+        "pacemaker, the gossip fallback engages (bounded fan-out instead "
+        "of silence), and liveness is clean after the restart.",
+        n=7,
+        plan=lambda: FaultPlan(
+            default_link=_LINK,
+            wan=WanMatrix(),
+            crashes=[CrashWindow(node=1, at=1.0, restart=6.0)],
+        ),
+        parameters=_agg_params,
+        duration=40.0,
+        min_commits=4,
+        heal_t=6.0,
+        expect=_expect_agg_crash,
+    )
+)
+
+
+def _expect_agg_byzantine(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "chaos.forged_votes")
+    # chaos.forged_timeouts is deliberately NOT required here: an
+    # early-stopping seed can reach its commit floor before any timeout
+    # round, and even in a stalled round node 1 may be that round's
+    # collector (it then relays no timeout bundle to poison). The
+    # timeout-plane poisoning coverage is pinned at the deterministic
+    # tier-1 seed in tests/test_overlay.py.
+    problems += _expect_counter(deltas, "chaos.withheld_votes")
+    problems += _expect_counter(deltas, "agg.invalid_entries")
+    problems += _expect_counter(deltas, "verifier.rejected_sigs")
+    problems += _expect_counter(deltas, "agg.entries_merged")
+    problems += _expect_counter(deltas, "consensus.commits", minimum=8)
+    if report.get("forged_triples_cached", 0) != 0:
+        problems.append(
+            f"{report['forged_triples_cached']} forged bundle entries found "
+            "in a VerifiedSigCache (rejected signatures must never be cached)"
+        )
+    return problems
+
+
+_register(
+    Scenario(
+        name="agg_byzantine_bundles",
+        description="Byzantine aggregator on the overlay plane: node 1 "
+        "poisons every partial bundle it relays — a garbage-signature "
+        "entry under an honest authority, plus its own timeout entry "
+        "re-signed over an ABSURD high_qc_round the carried QC cannot "
+        "back (the TC-poisoning shape) — and withholds every third "
+        "bundle outright. A crash window forces timeout rounds so the "
+        "timeout plane is exercised: every poisoned entry must reject "
+        "ALONE (the honest entries beside it still merge, real RFC 8032 "
+        "verification at n=4), nothing forged is ever cached, no TC "
+        "becomes unjustifiable, and commits continue.",
+        plan=lambda: FaultPlan(
+            default_link=_LINK,
+            wan=WanMatrix(),
+            crashes=[CrashWindow(node=2, at=1.0, restart=4.0)],
+        ),
+        byzantine={1: BundlePoisoner},
+        parameters=_agg_params,
+        duration=60.0,
+        min_commits=3,
+        heal_t=4.0,
+        expect=_expect_agg_byzantine,
+    )
+)
+
+
+def _expect_agg_epoch(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "reconfig.epoch_switches", minimum=3)
+    problems += _expect_counter(deltas, "reconfig.proposed")
+    problems += _expect_counter(deltas, "agg.bundles_sent")
+    problems += _expect_counter(deltas, "agg.entries_merged")
+    switches = report.get("epoch_switches", {})
+    if not switches:
+        return problems + ["no node recorded an epoch switch"]
+    acts = {e["activation_round"] for evs in switches.values() for e in evs}
+    if len(acts) != 1:
+        problems.append(f"nodes disagree on the activation round: {sorted(acts)}")
+        return problems
+    act = next(iter(acts))
+    # The original quorum committed on BOTH sides of the boundary: the
+    # pre-boundary commits rode epoch 1's tree, the post-boundary ones
+    # epoch 2's (node 3 out, node 4 in) — the per-round committee
+    # resolution is what rotates the tree at the seam.
+    for i in (0, 1, 2):
+        rounds = [r for r, _d in report["commits"].get(str(i), [])]
+        if not any(r < act for r in rounds):
+            problems.append(f"node {i} has no pre-boundary commit")
+        if not any(r > act for r in rounds):
+            problems.append(f"node {i} has no post-boundary commit")
+    return problems
+
+
+_register(
+    Scenario(
+        name="agg_epoch_boundary",
+        description="An epoch boundary crosses the aggregation tree: the "
+        "committee hands {0,1,2,3} -> {0,1,2,4} at a committed activation "
+        "round with the overlay ON — vote/timeout bundles route on epoch "
+        "1's tree before the boundary and epoch 2's after (the departed "
+        "node drops out of the tree, the joiner enters it), with commits "
+        "on both sides and one unanimous activation round.",
+        n=5,
+        committee=(0, 1, 2, 3),
+        plan=lambda: FaultPlan(default_link=_CATCHUP_LINK, wan=WanMatrix()),
+        parameters=_agg_params,
+        reconfig=lambda: ReconfigDirective(
+            at=2.0, add=(4,), remove=(3,), activation_margin=10
+        ),
+        duration=12.0,
+        min_commits=0,  # no early stop: the boundary must play out
+        expect=_expect_agg_epoch,
+    )
+)
+
+
 # The short sweep tier-1 runs (and the CLI's --scenario all default).
 SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
@@ -757,7 +1053,18 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 # faults expressed as per-link defaults or single-node crash windows, no
 # hardcoded committee subsets (tools/lint_metrics.py lint_matrix enforces
 # both that every name resolves here and that none pins a committee).
-MATRIX_SCENARIOS = ("baseline", "lossy_links", "leader_crash")
+# timeout_storm / timeout_storm_legacy are ISSUE 13's storm cells: the
+# same size-parameterized half|half stall with the overlay on vs off, so
+# the artifact carries BOTH frames-per-stalled-round numbers (the
+# `timeout_plane` block per cell) and the O(n²) -> O(n·fanout) win is a
+# committed, regression-tracked delta.
+MATRIX_SCENARIOS = (
+    "baseline",
+    "lossy_links",
+    "leader_crash",
+    "timeout_storm",
+    "timeout_storm_legacy",
+)
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)
 # Cells at/above this committee size run the trusted-crypto stub
@@ -823,8 +1130,24 @@ def run_matrix_cell(
         telemetry=matrix_telemetry_config(),
     )
     wall = _time.perf_counter() - t0
+    # Timeout-plane storm accounting (ISSUE 13): whenever the cell saw
+    # local timeouts, commit the frames-per-timeout ratio (and its
+    # per-stalled-round form, n× that) so the overlay-vs-legacy delta is
+    # diffable straight from the artifact.
+    cell_metrics = report.get("metrics", {})
+    timeouts = cell_metrics.get("consensus.timeouts", 0)
+    frames = cell_metrics.get("agg.timeout_frames", 0)
+    timeout_plane = None
+    if timeouts:
+        timeout_plane = {
+            "local_timeouts": timeouts,
+            "frames": frames,
+            "frames_per_timeout": round(frames / timeouts, 2),
+            "frames_per_stalled_round": round(n * frames / timeouts, 1),
+        }
     return {
         "cell": cell_name(scenario, seed, n),
+        "timeout_plane": timeout_plane,
         "scenario": scenario,
         "seed": seed,
         "n": n,
@@ -843,7 +1166,7 @@ def run_matrix_cell(
 
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
-    "telemetry.", "sync.", "reconfig.", "wan.",
+    "telemetry.", "sync.", "reconfig.", "wan.", "agg.",
 )
 
 
@@ -881,7 +1204,12 @@ def run_scenario(
             f"scenario {name!r} pins committee indices "
             f"{scenario.committee}; its node count cannot be overridden"
         )
-    plan = scenario.plan()
+    effective_n = n if n is not None else scenario.n
+    plan = (
+        scenario.plan_n(effective_n)
+        if scenario.plan_n is not None
+        else scenario.plan()
+    )
     if wan is not None:
         plan.wan = wan
     telemetry_config = (
@@ -894,7 +1222,7 @@ def run_scenario(
     async def body() -> dict:
         orch = ChaosOrchestrator(
             seed=seed,
-            n=n if n is not None else scenario.n,
+            n=effective_n,
             plan=plan,
             byzantine=dict(scenario.byzantine),
             parameters=scenario.parameters(),

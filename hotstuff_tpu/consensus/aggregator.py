@@ -17,7 +17,15 @@ from ..crypto import Digest, PublicKey, Signature
 from ..utils import metrics, tracing
 from .config import Committee
 from .errors import UnknownAuthorityError, ensure
-from .messages import QC, TC, Round, Timeout, Vote
+from .messages import (
+    QC,
+    TC,
+    Round,
+    Timeout,
+    Vote,
+    _timeout_digest,
+    _vote_digest,
+)
 from .reconfig import as_manager
 
 # qc_form_s / tc_form_s: first vote (or timeout) appended -> quorum fired —
@@ -38,14 +46,28 @@ class QCMaker:
         self._first_at: float | None = None
 
     def append(self, vote: Vote, committee: Committee) -> QC | None:
-        if vote.author in self.used:
+        return self.add(
+            vote.author, vote.signature, vote.round, vote.hash, committee
+        )
+
+    def add(
+        self,
+        author: PublicKey,
+        signature: Signature,
+        round_: Round,
+        hash_: Digest,
+        committee: Committee,
+    ) -> QC | None:
+        """Entry-level accumulation: the shape partial bundles arrive in
+        (consensus/overlay.py) — a Vote is just one entry."""
+        if author in self.used:
             return None  # redelivery (retries rebroadcast); not Byzantine
-        stake = committee.stake(vote.author)
-        ensure(stake > 0, UnknownAuthorityError(vote.author))
+        stake = committee.stake(author)
+        ensure(stake > 0, UnknownAuthorityError(author))
         if self._first_at is None:
             self._first_at = time.perf_counter()
-        self.used.add(vote.author)
-        self.votes.append((vote.author, vote.signature))
+        self.used.add(author)
+        self.votes.append((author, signature))
         self.weight += stake
         if self.weight >= committee.quorum_threshold():
             self.weight = 0  # fire exactly once (aggregator.rs:88)
@@ -55,11 +77,11 @@ class QCMaker:
             if tracing.enabled():
                 tracing.event(
                     "qc",
-                    tracing.trace_id(vote.round, vote.hash.data),
+                    tracing.trace_id(round_, hash_.data),
                     form_s,
                     votes=len(self.votes),
                 )
-            return QC(vote.hash, vote.round, tuple(self.votes))
+            return QC(hash_, round_, tuple(self.votes))
         return None
 
 
@@ -73,20 +95,40 @@ class TCMaker:
         self._first_at: float | None = None
 
     def append(self, timeout: Timeout, committee: Committee) -> TC | None:
-        if timeout.author in self.used:
+        return self.add(
+            timeout.author,
+            timeout.signature,
+            timeout.high_qc.round,
+            timeout.round,
+            committee,
+        )
+
+    def add(
+        self,
+        author: PublicKey,
+        signature: Signature,
+        high_qc_round: Round,
+        round_: Round,
+        committee: Committee,
+    ) -> TC | None:
+        """Entry-level accumulation for partial timeout bundles: only the
+        (author, signature, high_qc_round) triple is needed to weigh and
+        assemble the TC — the full high_qc rides the bundle once, not
+        once per author (consensus/overlay.py)."""
+        if author in self.used:
             return None  # redelivery (nodes re-timeout the same round)
-        stake = committee.stake(timeout.author)
-        ensure(stake > 0, UnknownAuthorityError(timeout.author))
+        stake = committee.stake(author)
+        ensure(stake > 0, UnknownAuthorityError(author))
         if self._first_at is None:
             self._first_at = time.perf_counter()
-        self.used.add(timeout.author)
-        self.votes.append((timeout.author, timeout.signature, timeout.high_qc.round))
+        self.used.add(author)
+        self.votes.append((author, signature, high_qc_round))
         self.weight += stake
         if self.weight >= committee.quorum_threshold():
             self.weight = 0
             _M_TCS.inc()
             _M_TC_FORM.record(time.perf_counter() - self._first_at)
-            return TC(timeout.round, tuple(self.votes))
+            return TC(round_, tuple(self.votes))
         return None
 
 
@@ -131,6 +173,35 @@ class Aggregator:
         self._seed(
             timeout.signed_digest(), timeout.author, timeout.signature
         )
+        return tc
+
+    # -- partial-bundle entries (consensus/overlay.py) -----------------------
+
+    def add_vote_entry(
+        self, round_: Round, hash_: Digest, author: PublicKey, sig: Signature
+    ) -> QC | None:
+        """One verified vote entry from a partial bundle: same maker (and
+        exactly-once quorum firing) as a full Vote for the same key."""
+        maker = self.votes_aggregators.setdefault((round_, hash_), QCMaker())
+        qc = maker.add(
+            author, sig, round_, hash_, self.epochs.committee_for_round(round_)
+        )
+        self._seed(_vote_digest(hash_, round_), author, sig)
+        return qc
+
+    def add_timeout_entry(
+        self, round_: Round, author: PublicKey, sig: Signature, high_qc_round: Round
+    ) -> TC | None:
+        """One verified timeout entry from a partial bundle."""
+        maker = self.timeouts_aggregators.setdefault(round_, TCMaker())
+        tc = maker.add(
+            author,
+            sig,
+            high_qc_round,
+            round_,
+            self.epochs.committee_for_round(round_),
+        )
+        self._seed(_timeout_digest(round_, high_qc_round), author, sig)
         return tc
 
     def cleanup(self, round_: Round) -> None:

@@ -100,6 +100,15 @@ class Parameters:
     # 1.0 disables backoff (reference behavior).
     timeout_backoff: float = 2.0
     max_timeout_delay: int = 30_000  # ms cap for the backed-off delay
+    # Region-aware aggregation overlay for the vote/timeout plane
+    # (consensus/overlay.py). Default OFF: the all-to-all plane is the
+    # committed-determinism baseline every pre-overlay scenario pins;
+    # fleet-scale deployments (and the overlay chaos scenarios) opt in.
+    aggregation_overlay: bool = False
+    agg_fanout: int = 4  # tree arity AND the gossip-fallback peer count
+    agg_hold_ms: int = 50  # interior merge window before forwarding up
+    agg_fallback_ms: int = 500  # stalled-round bound before gossip fallback
+    agg_max_forwards: int = 3  # upward re-forwards per (round, kind) key
 
     def log(self, log) -> None:
         # NOTE: these log entries are parsed by the benchmark LogParser.
@@ -117,6 +126,11 @@ class Parameters:
             "min_block_delay": self.min_block_delay,
             "timeout_backoff": self.timeout_backoff,
             "max_timeout_delay": self.max_timeout_delay,
+            "aggregation_overlay": self.aggregation_overlay,
+            "agg_fanout": self.agg_fanout,
+            "agg_hold_ms": self.agg_hold_ms,
+            "agg_fallback_ms": self.agg_fallback_ms,
+            "agg_max_forwards": self.agg_max_forwards,
         }
 
     @staticmethod

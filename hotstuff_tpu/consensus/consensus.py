@@ -38,6 +38,7 @@ class Consensus:
         verification_service=None,
         epoch_manager: EpochManager | None = None,
         listen_address: Address | None = None,
+        overlay_regions: dict[PublicKey, str] | None = None,
     ) -> Core:
         """Boot the consensus plane; returns the Core (its actor task is
         spawned). The committee addresses are this plane's listen ports.
@@ -51,7 +52,10 @@ class Consensus:
         from the genesis committee when not supplied. `listen_address`
         covers a node that is NOT in the genesis committee — a validator
         expecting to JOIN at a later epoch boundary still needs a bound
-        port to catch up and participate from."""
+        port to catch up and participate from. `overlay_regions` maps
+        authority keys to WAN region labels for the aggregation overlay's
+        region-aware tree (consensus/overlay.py); only consulted when
+        Parameters.aggregation_overlay is on."""
         # NOTE: boot-time config echo; parsed by the benchmark harness.
         parameters.log(log)
 
@@ -95,6 +99,7 @@ class Consensus:
             network_tx,
             commit_channel,
             verification_service=verification_service,
+            overlay_regions=overlay_regions,
         )
         spawn(core.run(), name="consensus-core")
         log.info(

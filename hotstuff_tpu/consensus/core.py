@@ -48,8 +48,19 @@ from .messages import (
     SyncRangeRequest,
     SyncRequest,
     Timeout,
+    TimeoutBundle,
     Vote,
+    VoteBundle,
+    _timeout_digest,
+    _vote_digest,
     encode_consensus_message,
+)
+from .overlay import (
+    KIND_TIMEOUT,
+    KIND_VOTE,
+    OverlayRouter,
+    filter_backed,
+    note_plane_frames,
 )
 from .reconfig import EpochChange, MIN_ACTIVATION_MARGIN, as_manager
 from .synchronizer import (
@@ -98,6 +109,7 @@ class Core:
         network_tx: asyncio.Queue,
         commit_channel: asyncio.Queue,
         verification_service=None,
+        overlay_regions: dict[PublicKey, str] | None = None,
     ) -> None:
         from ..crypto.batch_service import BatchVerificationService
 
@@ -130,6 +142,12 @@ class Core:
         # The aggregator seeds verified vote/timeout signatures into the
         # service's dedup cache, so assembled QCs/TCs short-circuit.
         self.aggregator = Aggregator(self.epochs, self.verification_service)
+        # Region-aware aggregation overlay (consensus/overlay.py). Always
+        # constructed — inbound partial bundles merge regardless; whether
+        # this node's OWN votes/timeouts ride the tree is gated by
+        # Parameters.aggregation_overlay (default off, the committed
+        # all-to-all baseline).
+        self.overlay = OverlayRouter(self, overlay_regions)
         self.timer: Timer | None = None  # created inside the running loop
         # EpochChange queued for this node's next proposal (schedule_reconfig)
         self._pending_reconfig: EpochChange | None = None
@@ -357,6 +375,7 @@ class Core:
         if self.timer is not None:
             self.timer.reset()
         self.aggregator.cleanup(self.round)
+        self.overlay.cleanup(self.round)
         # Round/high_qc persistence piggybacks on the next pre-vote or
         # pre-timeout safety write (exactly one flushed write per round);
         # only last_voted_round must be durable BEFORE a signature leaves.
@@ -400,7 +419,18 @@ class Core:
             )
             self.timer.set_delay_ms(max(delay, p.timeout_delay))
             self.timer.reset()
-        await self._transmit(timeout, None)
+        if self.overlay.enabled:
+            # Overlay mode: ONE bundle frame up the round's aggregation
+            # tree (plus a bounded gossip fallback if the round stays
+            # stalled) instead of an n-1 frame broadcast — the O(n²)
+            # timeout-storm fix (consensus/overlay.py).
+            await self.overlay.on_own_timeout(timeout)
+        else:
+            await self._transmit(timeout, None)
+            note_plane_frames(
+                KIND_TIMEOUT,
+                len(self.committee.broadcast_addresses(self.name)),
+            )
         await self._handle_timeout(timeout)
 
     # -- proposals -----------------------------------------------------------
@@ -481,11 +511,17 @@ class Core:
         next_leader = self.leader_elector.get_leader(self.round + 1)
         if next_leader == self.name:
             await self._handle_vote(vote)
+        elif self.overlay.enabled:
+            # Overlay mode: the vote rides the region-aware tree rooted
+            # at the next leader — interior nodes merge partial bundles
+            # so the leader's fan-in is O(fanout), not O(n).
+            await self.overlay.on_own_vote(vote)
         else:
             await self._transmit(
                 vote, next_leader,
                 trace=self._trace_ctx(vote.round, vote.hash),
             )
+            note_plane_frames(KIND_VOTE, 1)
 
     # -- message handlers ----------------------------------------------------
 
@@ -593,6 +629,137 @@ class Core:
             await self._transmit(tc, None)
             if self.leader_elector.get_leader(self.round) == self.name:
                 await self._generate_proposal(tc)
+
+    async def _handle_vote_bundle(self, bundle: VoteBundle) -> None:
+        """Aggregation-overlay partial vote quorum (consensus/overlay.py).
+        Unseen entries are batch-verified as ONE group on the scheduler's
+        `aggregate` lane; an invalid entry rejects ALONE (counted in
+        agg.invalid_entries) without poisoning the rest. The next leader
+        feeds verified entries straight into its QC aggregator; everyone
+        else merges and forwards one frame up the tree."""
+        self.overlay.note_received()
+        if bundle.round < self.round:
+            return
+        key = OverlayRouter.vote_key(bundle.round, bundle.hash)
+        fresh = self.overlay.fresh(key, bundle.votes)
+        if not fresh:
+            return
+        committee = self.epochs.committee_for_round(bundle.round)
+        known = [(pk, sig) for pk, sig in fresh if committee.stake(pk) > 0]
+        self.overlay.note_invalid(len(fresh) - len(known))
+        if not known:
+            return
+        digest = _vote_digest(bundle.hash, bundle.round).data
+        mask = await self.verification_service.verify_group(
+            [digest] * len(known), known, committee=True, source="aggregate",
+        )
+        valid = [entry for entry, ok in zip(known, mask) if ok]
+        self.overlay.note_invalid(len(known) - len(valid))
+        new = self.overlay.merge(key, valid)
+        if not new or bundle.round < self.round:
+            return
+        if self.leader_elector.get_leader(bundle.round + 1) == self.name:
+            for pk, sig in new:
+                qc = self.aggregator.add_vote_entry(
+                    bundle.round, bundle.hash, pk, sig
+                )
+                if qc is not None:
+                    # NOTE: parsed by the benchmark LogParser (+ AGG:).
+                    log.info(
+                        "Agg bundle quorum: QC round %s from %s entries",
+                        qc.round,
+                        len(qc.votes),
+                    )
+                    await self._process_qc(qc)
+                    if self.leader_elector.get_leader(self.round) == self.name:
+                        await self._generate_proposal(None)
+                    return
+        else:
+            await self.overlay.after_merge(key)
+
+    async def _handle_timeout_bundle(self, bundle: TimeoutBundle) -> None:
+        """Aggregation-overlay partial timeout quorum: entries and the
+        carried high_qc verify as one `aggregate`-lane group (the QC is
+        quorum-checked structurally first, like a Timeout's); any node
+        that accumulates 2f+1 merged entries assembles the TC and
+        broadcasts it — the storm-free replacement for every node
+        broadcasting every Timeout."""
+        self.overlay.note_received()
+        if bundle.round < self.round:
+            return
+        key = OverlayRouter.timeout_key(bundle.round)
+        fresh = self.overlay.fresh(key, bundle.timeouts)
+        committee = self.epochs.committee_for_round(bundle.round)
+        known = [entry for entry in fresh if committee.stake(entry[0]) > 0]
+        self.overlay.note_invalid(len(fresh) - len(known))
+        qc_ok: bool | None = bundle.high_qc.is_genesis()
+        if not qc_ok:
+            try:
+                bundle.high_qc.check_quorum(self.epochs)
+                qc_ok = None  # decided by the verification mask below
+            except ConsensusError:
+                self.overlay.note_invalid(1)
+                qc_ok = False
+        # Backing pre-filter: an entry's high_qc_round claim must be
+        # covered by the bundle's carried QC (overlay.filter_backed — a
+        # validly SIGNED but unbacked claim would poison every TC it
+        # enters with an unsatisfiable justification round). Claims above
+        # a structurally bad carried QC back to nothing (genesis only).
+        backed_round = 0
+        if qc_ok is not False and not bundle.high_qc.is_genesis():
+            backed_round = bundle.high_qc.round
+        known, unbacked = filter_backed(known, backed_round)
+        self.overlay.note_invalid(unbacked)
+        msgs = [
+            _timeout_digest(bundle.round, hqr).data for _pk, _sig, hqr in known
+        ]
+        pairs: list = [(pk, sig) for pk, sig, _hqr in known]
+        qc_lo = len(msgs)
+        if qc_ok is None:
+            m, p = bundle.high_qc.signed_items()
+            msgs += m
+            pairs += p
+        if not msgs:
+            return
+        mask = await self.verification_service.verify_group(
+            msgs, pairs, committee=True, source="aggregate",
+        )
+        valid = [entry for entry, ok in zip(known, mask[:qc_lo]) if ok]
+        self.overlay.note_invalid(len(known) - len(valid))
+        if qc_ok is None:
+            qc_ok = all(mask[qc_lo:])
+            if not qc_ok:
+                self.overlay.note_invalid(1)
+        if not qc_ok:
+            # The carried QC's signatures failed AFTER the pre-filter
+            # admitted claims against its round: those entries lost their
+            # backing — only genesis claims survive.
+            backed = [entry for entry in valid if entry[2] == 0]
+            self.overlay.note_invalid(len(valid) - len(backed))
+            valid = backed
+        adopt_qc = qc_ok and not bundle.high_qc.is_genesis()
+        new = self.overlay.merge(
+            key, valid, high_qc=bundle.high_qc if adopt_qc else None
+        )
+        if adopt_qc:
+            await self._process_qc(bundle.high_qc)
+        if not new or bundle.round < self.round:
+            return
+        for pk, sig, hqr in new:
+            tc = self.aggregator.add_timeout_entry(bundle.round, pk, sig, hqr)
+            if tc is not None:
+                # NOTE: parsed by the benchmark LogParser (+ AGG:).
+                log.info(
+                    "Agg bundle quorum: TC round %s from %s entries",
+                    tc.round,
+                    len(tc.votes),
+                )
+                await self._advance_round(tc.round)
+                await self._transmit(tc, None)
+                if self.leader_elector.get_leader(self.round) == self.name:
+                    await self._generate_proposal(tc)
+                return
+        await self.overlay.after_merge(key)
 
     async def _handle_tc(self, tc: TC) -> None:
         """A TC received directly (core.rs:438-444)."""
@@ -720,6 +887,10 @@ class Core:
                     await self._handle_vote(value)
                 elif isinstance(value, Timeout):
                     await self._handle_timeout(value)
+                elif isinstance(value, VoteBundle):
+                    await self._handle_vote_bundle(value)
+                elif isinstance(value, TimeoutBundle):
+                    await self._handle_timeout_bundle(value)
                 elif isinstance(value, TC):
                     await self._handle_tc(value)
                 elif isinstance(value, SyncRequest):

@@ -554,6 +554,14 @@ TAG_TC = 3
 TAG_SYNC_REQUEST = 4
 TAG_SYNC_RANGE_REQUEST = 5
 TAG_SYNC_RANGE_REPLY = 6
+# Aggregation-overlay partial-quorum bundles (consensus/overlay.py).
+TAG_VOTE_BUNDLE = 7
+TAG_TIMEOUT_BUNDLE = 8
+
+# Defensive cap on entries per partial bundle: an unauthenticated peer
+# must not make a receiver decode (and batch-verify) an unbounded entry
+# list per frame. Real bundles carry at most one committee's worth.
+MAX_BUNDLE_ENTRIES = 4096
 
 
 def encode_consensus_message(msg) -> bytes:
@@ -585,6 +593,29 @@ def encode_consensus_message(msg) -> bytes:
         w.u8(TAG_SYNC_RANGE_REPLY)
         w.fixed(msg.target.data, 32)
         w.seq(list(msg.blocks), lambda wr, b: b.encode(wr))
+    elif isinstance(msg, VoteBundle):
+        if len(msg.votes) > MAX_BUNDLE_ENTRIES:
+            raise ValueError(f"vote bundle over entry cap: {len(msg.votes)}")
+        w.u8(TAG_VOTE_BUNDLE)
+        w.u64(msg.round)
+        w.fixed(msg.hash.data, 32)
+        _encode_votes(w, list(msg.votes))
+    elif isinstance(msg, TimeoutBundle):
+        if len(msg.timeouts) > MAX_BUNDLE_ENTRIES:
+            raise ValueError(
+                f"timeout bundle over entry cap: {len(msg.timeouts)}"
+            )
+        w.u8(TAG_TIMEOUT_BUNDLE)
+        w.u64(msg.round)
+        msg.high_qc.encode(w)
+        w.seq(
+            list(msg.timeouts),
+            lambda wr, v: (
+                wr.fixed(v[0].data, 32),
+                wr.fixed(v[1].data, 64),
+                wr.u64(v[2]),
+            ),
+        )
     else:
         raise TypeError(f"not a consensus message: {msg!r}")
     return w.bytes()
@@ -616,6 +647,28 @@ def decode_consensus_message(data: bytes):
             # arbitrarily long chain segment per frame.
             raise SerdeError(f"range reply over batch cap: {len(blocks)}")
         out = SyncRangeReply(target, blocks)
+    elif tag == TAG_VOTE_BUNDLE:
+        round_ = r.u64()
+        hash_ = Digest(r.fixed(32))
+        votes = tuple(_decode_votes(r))
+        if len(votes) > MAX_BUNDLE_ENTRIES:
+            raise SerdeError(f"vote bundle over entry cap: {len(votes)}")
+        out = VoteBundle(round_, hash_, votes)
+    elif tag == TAG_TIMEOUT_BUNDLE:
+        round_ = r.u64()
+        high_qc = QC.decode(r)
+        timeouts = tuple(
+            r.seq(
+                lambda rd: (
+                    PublicKey(rd.fixed(32)),
+                    Signature(rd.fixed(64)),
+                    rd.u64(),
+                )
+            )
+        )
+        if len(timeouts) > MAX_BUNDLE_ENTRIES:
+            raise SerdeError(f"timeout bundle over entry cap: {len(timeouts)}")
+        out = TimeoutBundle(round_, high_qc, timeouts)
     else:
         raise SerdeError(f"unknown consensus tag {tag}")
     r.expect_done()
@@ -653,6 +706,47 @@ class SyncRangeReply:
 
     target: Digest
     blocks: tuple[Block, ...]
+
+
+@dataclass(frozen=True, slots=True)
+class VoteBundle:
+    """Aggregation-overlay partial quorum for one (round, block digest):
+    a mergeable set of individually signed votes (consensus/overlay.py).
+    Unauthenticated as a CONTAINER — each (author, signature) entry is
+    batch-verified against `_vote_digest(hash, round)` by the receiver
+    before it merges, and an invalid entry is dropped alone (it cannot
+    poison the rest of the bundle)."""
+
+    round: Round
+    hash: Digest
+    votes: tuple[tuple[PublicKey, Signature], ...]
+
+    def signed_digest(self) -> Digest:
+        return _vote_digest(self.hash, self.round)
+
+    def __str__(self) -> str:
+        return f"VB{self.round}({self.hash.short()}, {len(self.votes)} votes)"
+
+
+@dataclass(frozen=True, slots=True)
+class TimeoutBundle:
+    """Aggregation-overlay partial quorum for one timed-out round: a
+    mergeable set of (author, signature, high_qc_round) timeout entries
+    plus the highest QC any merged author reported (ONE certificate per
+    bundle instead of one per timeout — the storm-shrinking payload).
+    Entries verify individually against `_timeout_digest(round, hqr)`;
+    the carried high_qc is quorum-checked and batch-verified before
+    adoption, exactly like a Timeout's."""
+
+    round: Round
+    high_qc: QC
+    timeouts: tuple[tuple[PublicKey, Signature, Round], ...]
+
+    def __str__(self) -> str:
+        return (
+            f"TB{self.round}(high_qc round {self.high_qc.round}, "
+            f"{len(self.timeouts)} timeouts)"
+        )
 
 
 @dataclass(frozen=True, slots=True)

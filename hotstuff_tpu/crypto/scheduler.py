@@ -84,6 +84,7 @@ __all__ = [
     "SourceClass",
     "SOURCE_CLASSES",
     "CONSENSUS",
+    "AGGREGATE",
     "SYNC",
     "INGRESS",
     "MEMPOOL",
@@ -114,19 +115,24 @@ class SourceClass:
     preemptive: bool = False
 
 
-# The four registered sources (ISSUE 7 / ROADMAP item 4). QC/TC/vote/
-# proposal checks gate round advancement — preemptive, no flush timer.
-# Sync/payload re-verification un-stalls consensus availability — tight
-# deadline, drained first among the batched lanes. Ingress is client-
+# The five registered sources (ISSUE 7 / ROADMAP item 4; ISSUE 13 filled
+# the slot PR 7 left open). QC/TC/vote/proposal checks gate round
+# advancement — preemptive, no flush timer. AGGREGATE is the overlay's
+# partial-bundle verification (consensus/overlay.py): quorum-forming but
+# mergeable-in-batches, so it rides the batched device path at a priority
+# strictly between consensus and sync (a stalled round's bundles must not
+# queue behind catch-up or ingress floods). Sync/payload re-verification
+# un-stalls consensus availability — tight deadline. Ingress is client-
 # latency-sensitive bulk; mempool is pure measurement load and starves
 # first under pressure (the lane contract, mirroring ingress admission).
 CONSENSUS = SourceClass("consensus", 0, slo_s=0.002, max_delay_s=0.0, preemptive=True)
-SYNC = SourceClass("sync", 1, slo_s=0.020, max_delay_s=0.001)
-INGRESS = SourceClass("ingress", 2, slo_s=0.100, max_delay_s=0.002)
-MEMPOOL = SourceClass("mempool", 3, slo_s=0.500, max_delay_s=0.004)
+AGGREGATE = SourceClass("aggregate", 1, slo_s=0.010, max_delay_s=0.0005)
+SYNC = SourceClass("sync", 2, slo_s=0.020, max_delay_s=0.001)
+INGRESS = SourceClass("ingress", 3, slo_s=0.100, max_delay_s=0.002)
+MEMPOOL = SourceClass("mempool", 4, slo_s=0.500, max_delay_s=0.004)
 
 SOURCE_CLASSES: dict[str, SourceClass] = {
-    c.name: c for c in (CONSENSUS, SYNC, INGRESS, MEMPOOL)
+    c.name: c for c in (CONSENSUS, AGGREGATE, SYNC, INGRESS, MEMPOOL)
 }
 
 
@@ -144,6 +150,19 @@ def resolve_source(source: str | None, urgent: bool) -> SourceClass:
             ) from None
     return CONSENSUS if urgent else MEMPOOL
 
+
+# Sub-resolution deadline guard (the utils/actors.py Timer.RESOLUTION_S
+# class of livelock, observed live on the chaos virtual-time loop once
+# the overlay's `aggregate` lane made batched deadlines common in
+# consensus scenarios): when a pending deadline lands WITHIN the event
+# loop clock's resolution of `now` (vtime jumps overshoot by 1e-9), the
+# armed wait_for timer fires without the clock advancing, form_bucket
+# still judges the deadline "strictly in the future", and the run loop
+# re-arms forever at a frozen virtual instant. Deadlines within this
+# bound count as DUE — in form_bucket and the run loop alike (the two
+# must agree, or the loop waits for a deadline the bucket logic already
+# considers expired). One microsecond is far below any max_delay_s.
+RESOLUTION_S = 1e-6
 
 _M_SUBMITTED = metrics.counter("scheduler.submitted")
 # Cross-chip work stealing (ISSUE 9 / ROADMAP items 1+4): a bulk bucket
@@ -418,7 +437,7 @@ class DeviceScheduler:
                 target = (pending // align) * align
             else:
                 deadline = self._next_deadline()
-                if deadline is not None and now >= deadline:
+                if deadline is not None and now >= deadline - RESOLUTION_S:
                     reason = "deadline"
         if reason is None:
             return None
@@ -478,8 +497,8 @@ class DeviceScheduler:
         end = loop.time() + dur
         while True:
             remaining = end - loop.time()
-            if remaining <= 0:
-                return
+            if remaining <= RESOLUTION_S:
+                return  # sub-resolution remainder: same livelock class
             self._wake.clear()
             try:
                 await asyncio.wait_for(self._wake.wait(), remaining)
@@ -550,10 +569,11 @@ class DeviceScheduler:
                     continue
             # 3. Nothing dispatchable: wait for new work, a freed bulk
             #    slot, or the earliest pending deadline. form_bucket only
-            #    returns None while every pending deadline is strictly in
-            #    the future, so the timeout is always > 0 (no zero-delay
-            #    re-arm livelock under the virtual clock — utils/actors.py
-            #    Timer RESOLUTION_S rationale).
+            #    returns None while every pending deadline is more than
+            #    RESOLUTION_S in the future, so the armed timeout always
+            #    exceeds the loop clock's resolution (no sub-resolution
+            #    re-arm livelock under the virtual clock — see
+            #    RESOLUTION_S above).
             self._wake.clear()
             if self.depth() > 0 and self._ship_critical(loop.time()):
                 continue  # raced a critical submit against the clear
@@ -561,7 +581,10 @@ class DeviceScheduler:
             waitable = self._pick_backend() is not None
             timeout = None
             if deadline is not None and waitable:
-                timeout = max(0.0, deadline - loop.time())
+                # form_bucket returned None, so the deadline is more than
+                # RESOLUTION_S away; the floor keeps the armed timer past
+                # the loop clock's resolution regardless (see RESOLUTION_S).
+                timeout = max(deadline - loop.time(), RESOLUTION_S)
             try:
                 await asyncio.wait_for(self._wake.wait(), timeout)
             except asyncio.TimeoutError:

@@ -22,7 +22,11 @@ requests/replies, and the consensus synchronizer's recovery traffic —
 per-digest SyncRequests, batched catch-up SyncRangeRequest/Reply
 (consensus/messages.py) and the blocks served for them. Recovery frames
 un-stall consensus; queueing them behind megabytes of bulk gossip is
-exactly the stall they exist to clear.
+exactly the stall they exist to clear. The aggregation overlay's TIMEOUT
+bundles (TAG_TIMEOUT_BUNDLE, consensus/messages.py + overlay.py) ride
+the same hot lane — a stalled round's partial quorum IS recovery
+traffic — while vote bundles stay on the cold lane with the votes they
+replace.
 """
 
 from __future__ import annotations

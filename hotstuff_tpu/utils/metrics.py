@@ -537,6 +537,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("scheduler.depth", "gauge", None),
     ("scheduler.bucket_size", "histogram", SIZE_BUCKETS),
     ("scheduler.queue_consensus_s", "histogram", None),
+    ("scheduler.queue_aggregate_s", "histogram", None),
     ("scheduler.queue_sync_s", "histogram", None),
     ("scheduler.queue_ingress_s", "histogram", None),
     ("scheduler.queue_mempool_s", "histogram", None),
@@ -575,6 +576,17 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("reconfig.rejected", "counter", None),
     ("reconfig.late_applies", "counter", None),
     ("reconfig.epoch", "gauge", None),
+    # consensus/overlay.py — region-aware aggregation overlay (§5.5l).
+    # vote_frames/timeout_frames count plane frames in BOTH modes (bundle
+    # and legacy), so the timeout_storm matrix cells' frames-per-timeout
+    # ratio is mode-comparable.
+    ("agg.bundles_sent", "counter", None),
+    ("agg.bundles_received", "counter", None),
+    ("agg.entries_merged", "counter", None),
+    ("agg.invalid_entries", "counter", None),
+    ("agg.fallbacks", "counter", None),
+    ("agg.vote_frames", "counter", None),
+    ("agg.timeout_frames", "counter", None),
     ("consensus.round", "gauge", None),
     ("consensus.proposal_to_vote_s", "histogram", None),
     ("consensus.qc_form_s", "histogram", None),

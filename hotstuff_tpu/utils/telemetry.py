@@ -179,6 +179,7 @@ class TelemetryConfig:
     counter_prefixes: tuple[str, ...] = _DEFAULT_PREFIXES
     histograms: tuple[str, ...] = (
         "scheduler.queue_consensus_s",
+        "scheduler.queue_aggregate_s",
         "scheduler.queue_sync_s",
         "scheduler.queue_ingress_s",
         "scheduler.queue_mempool_s",
@@ -673,7 +674,7 @@ def merge_lane_summaries(per_node: dict[str, dict]) -> dict[str, dict]:
 # Counter prefixes a matrix cell keeps from the scenario's metric deltas:
 # the scale/health counters a regression diff is judged on, not the full
 # delta dump (which stays in the per-scenario report).
-_ROLLUP_COUNTER_PREFIXES = ("sync.", "reconfig.", "wan.", "chaos.")
+_ROLLUP_COUNTER_PREFIXES = ("sync.", "reconfig.", "wan.", "chaos.", "agg.")
 
 
 def fleet_rollup(report: dict) -> dict:

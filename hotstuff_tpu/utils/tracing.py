@@ -117,6 +117,8 @@ EVENT_KINDS: frozenset[str] = frozenset(STAGES) | {
     "ingress.forward",
     "ingress.reject",
     "verify.batch",
+    "agg.bundle",
+    "agg.fallback",
     "backpressure.on",
     "backpressure.off",
     "chaos.fault",

@@ -1,0 +1,408 @@
+"""Aggregation-overlay tests (ISSUE 13): deterministic region-aware tree
+derivation, partial-bundle wire format, entry-level QC/TC accumulation
+against REAL RFC 8032 signatures, the `aggregate` scheduler lane, the
+overlay chaos scenarios' bit-identical replay, and the LogParser's
+`+ AGG:` section.
+
+Dependency-free (no `cryptography`, no jax): signatures ride pysigner.
+"""
+
+import pytest
+
+from hotstuff_tpu.chaos import run_scenario
+from hotstuff_tpu.consensus.aggregator import Aggregator
+from hotstuff_tpu.consensus.config import Committee
+from hotstuff_tpu.consensus.messages import (
+    MAX_BUNDLE_ENTRIES,
+    QC,
+    TimeoutBundle,
+    VoteBundle,
+    _timeout_digest,
+    _vote_digest,
+    decode_consensus_message,
+    encode_consensus_message,
+)
+from hotstuff_tpu.consensus.overlay import (
+    KIND_TIMEOUT,
+    KIND_VOTE,
+    AggregationTree,
+)
+from hotstuff_tpu.crypto import pysigner
+from hotstuff_tpu.crypto.primitives import Digest, PublicKey, Signature
+from hotstuff_tpu.utils.serde import SerdeError
+
+pytestmark = pytest.mark.chaos
+
+
+def _committee(n: int, stake: int = 1):
+    keys = sorted(pysigner.keypair_from_seed(bytes([i + 1]) * 32) for i in range(n))
+    keys = [(PublicKey(pk), seed) for pk, seed in keys]
+    committee = Committee.new(
+        [(pk, stake, ("127.0.0.1", 9_000 + i)) for i, (pk, _s) in enumerate(keys)]
+    )
+    return keys, committee
+
+
+def _regions(members, labels=("ra", "rb", "rc", "rd")):
+    return {pk: labels[i % len(labels)] for i, pk in enumerate(sorted(members))}
+
+
+# --- tree derivation --------------------------------------------------------
+
+
+def test_tree_is_deterministic_and_rotates_with_round():
+    keys, _ = _committee(12)
+    members = [pk for pk, _s in keys]
+    regions = _regions(members)
+    a = AggregationTree(members, regions, 7, KIND_TIMEOUT, fanout=3)
+    b = AggregationTree(members, regions, 7, KIND_TIMEOUT, fanout=3)
+    assert a.order == b.order and a.collector == b.collector
+    assert all(a.parent(pk) == b.parent(pk) for pk in members)
+    # a different round permutes duty (overwhelmingly likely at n=12)
+    c = AggregationTree(members, regions, 8, KIND_TIMEOUT, fanout=3)
+    assert a.order != c.order
+    # and the vote/timeout planes derive independent trees
+    d = AggregationTree(members, regions, 7, KIND_VOTE, fanout=3,
+                        collector=a.collector)
+    assert d.order != a.order
+
+
+def test_tree_structure_bounds():
+    """Every member reaches the collector; interior fan-in respects the
+    fanout; each root path crosses regions AT MOST once (intra-region
+    subtrees first, one cross-region hop to the collector)."""
+    keys, _ = _committee(16)
+    members = [pk for pk, _s in keys]
+    regions = _regions(members)
+    tree = AggregationTree(members, regions, 3, KIND_TIMEOUT, fanout=2)
+    n_regions = len(set(regions.values()))
+    for pk in members:
+        # walk to the collector, bounded (no cycles)
+        hops, cross, cur = 0, 0, pk
+        while tree.parent(cur) is not None:
+            parent = tree.parent(cur)
+            if regions[cur] != regions[parent]:
+                cross += 1
+            cur = parent
+            hops += 1
+            assert hops <= len(members)
+        assert cur == tree.collector
+        assert cross <= 1, f"{pk.short()} crossed regions {cross} times"
+        kids = tree.children(pk)
+        bound = 2 + (n_regions if pk == tree.collector else 0)
+        assert len(kids) <= bound
+    # subtree sizes partition the committee under the collector
+    assert tree.subtree_size(tree.collector) == len(members)
+    assert tree.cross_region_edges() <= n_regions
+
+
+def test_tree_collector_placement():
+    keys, _ = _committee(7)
+    members = [pk for pk, _s in keys]
+    ordered = sorted(members)
+    # plurality region hosts the timeout collector
+    regions = {pk: ("big" if i < 5 else "small") for i, pk in enumerate(ordered)}
+    tree = AggregationTree(members, regions, 1, KIND_TIMEOUT, fanout=4)
+    assert regions[tree.collector] == "big"
+    # the vote plane pins the collector to the given leader, even when
+    # the leader sits outside the member set (epoch-seam case)
+    leader = ordered[0]
+    vtree = AggregationTree(members, regions, 1, KIND_VOTE, 4, collector=leader)
+    assert vtree.collector == leader
+    outsider = PublicKey(b"\xee" * 32)
+    etree = AggregationTree(members, regions, 1, KIND_VOTE, 4, collector=outsider)
+    assert etree.collector == outsider
+    assert all(
+        etree.parent(pk) is not None for pk in members
+    )  # everyone still drains toward it
+    # fallback peers: k distinct members, never self
+    peers = tree.fallback_peers(members[0], 3)
+    assert len(peers) == 3 and members[0] not in peers
+
+
+# --- bundle wire format -----------------------------------------------------
+
+
+def test_bundle_serde_roundtrip():
+    keys, _ = _committee(4)
+    h = Digest(b"\x05" * 32)
+    votes = tuple(
+        (pk, Signature(pysigner.sign(seed, _vote_digest(h, 9).data)))
+        for pk, seed in keys[:3]
+    )
+    vb = VoteBundle(9, h, votes)
+    assert decode_consensus_message(encode_consensus_message(vb)) == vb
+    timeouts = tuple(
+        (pk, Signature(pysigner.sign(seed, _timeout_digest(9, 4).data)), 4)
+        for pk, seed in keys[:3]
+    )
+    tb = TimeoutBundle(9, QC.genesis(), timeouts)
+    assert decode_consensus_message(encode_consensus_message(tb)) == tb
+
+
+def test_bundle_entry_cap_enforced():
+    entry = (PublicKey(b"\x01" * 32), Signature(b"\x02" * 64))
+    over = VoteBundle(1, Digest.zero(), tuple([entry] * (MAX_BUNDLE_ENTRIES + 1)))
+    with pytest.raises(ValueError):
+        encode_consensus_message(over)
+    # a hostile frame actually CARRYING too many entries dies in decode
+    # (built by hand — the encoder above refuses to produce one)
+    from hotstuff_tpu.consensus.messages import TAG_VOTE_BUNDLE
+    from hotstuff_tpu.utils.serde import Writer
+
+    w = Writer()
+    w.u8(TAG_VOTE_BUNDLE)
+    w.u64(1)
+    w.fixed(Digest.zero().data, 32)
+    w.seq(
+        [entry] * (MAX_BUNDLE_ENTRIES + 1),
+        lambda wr, v: (wr.fixed(v[0].data, 32), wr.fixed(v[1].data, 64)),
+    )
+    with pytest.raises(SerdeError):
+        decode_consensus_message(w.bytes())
+
+
+# --- entry-level aggregation against real RFC 8032 signatures ---------------
+
+
+def test_add_vote_entries_assemble_verifying_qc():
+    """Partial-bundle entries accumulate into a QC that passes FULL
+    RFC 8032 batch verification — the n=4 exact-crypto acceptance row."""
+    keys, committee = _committee(4)
+    agg = Aggregator(committee)
+    h = Digest(b"\x07" * 32)
+    signed = _vote_digest(h, 5).data
+    qc = None
+    for pk, seed in keys[:3]:
+        assert qc is None
+        sig = Signature(pysigner.sign(seed, signed))
+        qc = agg.add_vote_entry(5, h, pk, sig)
+    assert qc is not None and qc.round == 5 and len(qc.votes) == 3
+    qc.check_quorum(committee)  # structural: 2f+1 distinct known authors
+    # every aggregated signature re-verifies under real RFC 8032
+    # (pysigner — this host carries no OpenSSL-backed `cryptography`)
+    assert all(
+        pysigner.verify_exact(pk.data, qc.signed_digest().data, sig.data)
+        for pk, sig in qc.votes
+    )
+    # duplicate author never double-counts (and cannot re-fire)
+    pk, seed = keys[0]
+    again = agg.add_vote_entry(5, h, pk, Signature(pysigner.sign(seed, signed)))
+    assert again is None
+
+
+def test_add_timeout_entries_assemble_verifying_tc():
+    keys, committee = _committee(4)
+    agg = Aggregator(committee)
+    tc = None
+    for pk, seed in keys[:3]:
+        assert tc is None
+        sig = Signature(pysigner.sign(seed, _timeout_digest(6, 2).data))
+        tc = agg.add_timeout_entry(6, pk, sig, 2)
+    assert tc is not None and tc.round == 6
+    assert tc.high_qc_rounds() == [2, 2, 2]
+    tc.check_quorum(committee)
+    msgs, pairs = tc.signed_items()
+    assert all(
+        pysigner.verify_exact(pk.data, msg, sig.data)
+        for msg, (pk, sig) in zip(msgs, pairs)
+    )
+    # an entry from an unknown authority raises, same as a full Timeout
+    from hotstuff_tpu.consensus.errors import UnknownAuthorityError
+
+    with pytest.raises(UnknownAuthorityError):
+        agg.add_timeout_entry(6, PublicKey(b"\xaa" * 32), Signature(b"\x00" * 64), 0)
+
+
+def test_filter_backed_drops_unbacked_hqr_claims():
+    """The TC-poisoning guard: a timeout entry's high_qc_round claim must
+    be covered by the bundle's verified carried QC — a validly SIGNED but
+    unbacked claim would make every TC containing it unjustifiable
+    (block.qc.round >= max(tc.high_qc_rounds()) never satisfiable)."""
+    from hotstuff_tpu.consensus.overlay import filter_backed
+
+    pk = PublicKey(b"\x01" * 32)
+    sig = Signature(b"\x02" * 64)
+    entries = [(pk, sig, 0), (pk, sig, 5), (pk, sig, 6), (pk, sig, 10**6)]
+    ok, dropped = filter_backed(entries, backed_round=5)
+    assert [e[2] for e in ok] == [0, 5] and dropped == 2
+    # genesis backing (carried QC invalid or genesis): only hqr=0 survives
+    ok, dropped = filter_backed(entries, backed_round=0)
+    assert [e[2] for e in ok] == [0] and dropped == 3
+    assert filter_backed([], 7) == ([], 0)
+
+
+# --- the aggregate scheduler lane -------------------------------------------
+
+
+def test_aggregate_lane_registered_between_consensus_and_sync():
+    from hotstuff_tpu.crypto import scheduler as sched
+
+    agg = sched.SOURCE_CLASSES["aggregate"]
+    assert not agg.preemptive  # bundles ride the batched device path
+    assert sched.CONSENSUS.priority < agg.priority < sched.SYNC.priority
+    assert sched.resolve_source("aggregate", urgent=False) is sched.AGGREGATE
+    order = sched.drain_order()
+    assert "aggregate" in order  # the starvation lint's invariant
+    assert order.index("aggregate") < order.index("mempool")
+
+
+# --- overlay scenarios: bit-identical replay --------------------------------
+
+
+@pytest.mark.parametrize(
+    "name,duration",
+    [("timeout_storm", None), ("agg_byzantine_bundles", 20.0)],
+)
+def test_overlay_scenarios_replay_bit_identically(name, duration):
+    """ISSUE 13 acceptance: same seed => identical fault trace, commits,
+    lifecycle events AND bundle traffic (every agg.* counter) for the
+    overlay scenarios."""
+    a = run_scenario(name, seed=7, duration=duration)
+    b = run_scenario(name, seed=7, duration=duration)
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["commits"] == b["commits"]
+    assert a["events"] == b["events"]
+    agg_a = {k: v for k, v in a["metrics"].items() if k.startswith("agg.")}
+    agg_b = {k: v for k, v in b["metrics"].items() if k.startswith("agg.")}
+    assert agg_a == agg_b and agg_a.get("agg.bundles_sent", 0) > 0
+
+
+def test_timeout_storm_overlay_shrinks_frames_per_timeout():
+    """The storm acceptance shape at sweep scale: overlay frames per
+    local timeout stay under the O(fanout) bound while the legacy plane
+    pays exactly n-1 — the committed matrix cells pin the same ratio at
+    n=64 (timeout_storm vs timeout_storm_legacy in CHAOS_MATRIX_rN)."""
+    from hotstuff_tpu.chaos.scenarios import AGG_STORM_FRAMES_PER_TIMEOUT
+
+    r = run_scenario("timeout_storm", seed=11)
+    assert r["ok"], r
+    m = r["metrics"]
+    fpt = m["agg.timeout_frames"] / m["consensus.timeouts"]
+    assert 0 < fpt <= AGG_STORM_FRAMES_PER_TIMEOUT
+    assert m["agg.fallbacks"] > 0  # no quorum in the window: fallback fired
+    assert m["agg.bundles_sent"] > 0
+    assert m["wan.cross_region_frames"] > 0  # region-aware accounting live
+
+
+@pytest.mark.slow
+def test_timeout_storm_legacy_baseline_is_all_to_all():
+    """The committed pre-overlay baseline cell (slow tier; the matrix
+    artifact carries its n=64 number): every local timeout broadcasts
+    n-1 frames, and no overlay bundle ever flows."""
+    r = run_scenario("timeout_storm_legacy", seed=11)
+    assert r["ok"], r
+    m = r["metrics"]
+    n = r["nodes"]
+    assert m["agg.timeout_frames"] / m["consensus.timeouts"] == n - 1
+    assert "agg.bundles_sent" not in m
+
+
+def test_agg_collector_crash_fallback_engages():
+    r = run_scenario("agg_collector_crash", seed=11)
+    assert r["ok"], r
+    m = r["metrics"]
+    assert m["agg.fallbacks"] > 0
+    assert m["chaos.crashes"] == 1 and m["chaos.restarts"] == 1
+    assert r["liveness_violations"] == []
+
+
+def test_agg_byzantine_bundles_reject_without_poisoning():
+    r = run_scenario("agg_byzantine_bundles", seed=11)
+    assert r["ok"], r
+    m = r["metrics"]
+    # forged entries were injected, every one rejected alone...
+    assert m["chaos.forged_votes"] > 0
+    # ...including the TC-poisoning shape: legitimately SIGNED timeout
+    # entries claiming an unbacked high_qc_round (deterministic at this
+    # seed — the crash window forces timeout rounds node 1 poisons)
+    assert m["chaos.forged_timeouts"] > 0
+    assert m["agg.invalid_entries"] > 0
+    assert m["verifier.rejected_sigs"] > 0
+    # ...while the honest entries they rode beside still merged and the
+    # chain kept committing on real RFC 8032 verification
+    assert m["agg.entries_merged"] > 0
+    assert m["consensus.commits"] >= 8
+    assert r.get("forged_triples_cached", 0) == 0
+    assert not any("FALSE ACCEPT" in v for v in r["safety_violations"])
+
+
+def test_agg_epoch_boundary_rotates_tree():
+    r = run_scenario("agg_epoch_boundary", seed=11)
+    assert r["ok"], r
+    switches = r["epoch_switches"]
+    acts = {e["activation_round"] for evs in switches.values() for e in evs}
+    assert len(acts) == 1
+    act = acts.pop()
+    # bundles flowed, and the original quorum committed on both sides of
+    # the boundary — pre-boundary traffic rode epoch 1's tree, post-
+    # boundary traffic epoch 2's (per-round committee resolution)
+    assert r["metrics"]["agg.bundles_sent"] > 0
+    for i in ("0", "1", "2"):
+        rounds = [rnd for rnd, _d in r["commits"][i]]
+        assert any(rnd < act for rnd in rounds)
+        assert any(rnd > act for rnd in rounds)
+
+
+# --- LogParser + AGG section ------------------------------------------------
+
+
+def test_log_parser_scrapes_agg_section():
+    from benchmark.logs import LogParser
+
+    node_log = (
+        "[2025-01-01T00:00:00.000Z INFO] Timeout delay set to 1000 ms\n"
+        "[2025-01-01T00:00:01.000Z INFO] Agg bundle quorum: QC round 4 from 3 entries\n"
+        "[2025-01-01T00:00:02.000Z INFO] Agg bundle quorum: TC round 5 from 3 entries\n"
+        "[2025-01-01T00:00:03.000Z INFO] Agg fallback round 5: 2 entries to 4 peers\n"
+    )
+    parser = LogParser([], [node_log])
+    assert parser.agg_quorums == [("QC", 4, 3), ("TC", 5, 3)]
+    assert parser.agg_fallbacks == [(5, 2, 4)]
+    out = parser.result()
+    assert "+ AGG:" in out
+    assert "Bundle quorums: 2 (1 QC, 1 TC) from 6 merged entries" in out
+    assert "Fallbacks: 1 (2 entries gossiped over 4 frames)" in out
+    # overlay-less logs carry no AGG section
+    assert "+ AGG:" not in LogParser([], ["plain log\n"]).result()
+
+
+def test_trace_report_renders_bundle_lane():
+    import os
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(__file__), "..", "tools")
+    )
+    from trace_report import agg_bundle_table, chrome_trace
+
+    nodes = [
+        {
+            "node": "0",
+            "offset": 0.0,
+            "events": [
+                {"kind": "agg.bundle", "t": 1.0,
+                 "data": {"round": 3, "kind": "vote", "entries": 2}},
+                {"kind": "agg.bundle", "t": 1.2,
+                 "data": {"round": 3, "kind": "timeout", "entries": 5}},
+                {"kind": "agg.fallback", "t": 1.5,
+                 "data": {"round": 3, "peers": 4, "entries": 5}},
+            ],
+            "intervals": [],
+        },
+        {"node": "1", "offset": 0.0, "events": [], "intervals": []},
+    ]
+    table = agg_bundle_table(nodes)
+    assert "Aggregation overlay" in table
+    assert "| 0 | 2 | 1 | 1 | 7 | 5 | 1 |" in table
+    trace = chrome_trace(nodes)
+    lanes = [
+        e for e in trace["traceEvents"]
+        if e.get("name") == "thread_name"
+        and e.get("args", {}).get("name") == "aggregation"
+    ]
+    assert len(lanes) == 1  # only the node with agg events grows the lane
+    agg_events = [
+        e for e in trace["traceEvents"] if str(e.get("name", "")).startswith("agg.")
+    ]
+    assert agg_events and all(e["tid"] == lanes[0]["tid"] for e in agg_events)

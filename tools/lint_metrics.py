@@ -18,7 +18,9 @@ grow (a dump must carry EVERY name, zeros included — a name registered
 only at a call site would appear in some processes and not others), and
 keeps the trace-stage vocabulary stable for `tools/trace_report.py`.
 
-The scheduler lint (crypto/scheduler.py) additionally fails rc 1 when:
+The scheduler lint (crypto/scheduler.py) additionally fails rc 1 when
+(the `aggregate` bundle-verification class from consensus/overlay.py is
+covered like any other registered class — queue row, SLO, drain order):
 
   * a `source="…"` literal at any `verify_group`/`verify` call site
     names a class missing from `scheduler.SOURCE_CLASSES` (it would

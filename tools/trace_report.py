@@ -23,6 +23,10 @@ Outputs:
     (crypto/scheduler.py), the queueing delay and flush cost distribution
     aggregated from `verify.batch` events' lane/queue_s tags: the
     before/after queueing attribution per class.
+  * an **aggregation-overlay table** — per node, the partial-quorum
+    bundle hops (entries merged per upward frame) and gossip fallbacks
+    from `agg.bundle` / `agg.fallback` events; in the Chrome trace these
+    render on their own "aggregation" lane per node.
   * an **ingress-leg table** — the client path's admission
     (recv -> admit) and queue+verify (admit -> forward) legs aggregated
     from `ingress.*` events, plus shed/reject counts (ROADMAP item 3's
@@ -49,6 +53,10 @@ import sys
 
 STAGES = ("propose", "payload", "verify", "vote", "qc", "commit")
 _BLOCK_TRACE = re.compile(r"^r(\d+)-([0-9a-f]{16})$")
+# Chrome-trace thread row for aggregation-overlay events: well above the
+# per-node device-slot rows (which start at tid 2 and grow with pipeline
+# depth), so the lanes never collide.
+_AGG_TID = 32
 
 
 def load_inputs(paths: list[str]) -> list[dict]:
@@ -223,6 +231,46 @@ def verify_lane_table(nodes: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def agg_bundle_table(nodes: list[dict]) -> str:
+    """Aggregation-overlay bundle hops (consensus/overlay.py): per node,
+    the bundles it shipped up the tree (entries merged per hop) and the
+    gossip fallbacks it fired — rendered as their own lane so a stalled
+    round's partial-quorum traffic is separable from the block lifecycle
+    rows."""
+    rows = []
+    for rec in nodes:
+        bundles = fallbacks = 0
+        entries: list[int] = []
+        vote_b = timeout_b = 0
+        for e in rec["events"]:
+            kind = e.get("kind")
+            data = e.get("data") or {}
+            if kind == "agg.bundle":
+                bundles += 1
+                entries.append(int(data.get("entries", 0)))
+                if data.get("kind") == "vote":
+                    vote_b += 1
+                else:
+                    timeout_b += 1
+            elif kind == "agg.fallback":
+                fallbacks += 1
+        if not bundles and not fallbacks:
+            continue
+        max_entries = max(entries, default=0)
+        rows.append(
+            f"| {rec['node']} | {bundles} | {vote_b} | {timeout_b} "
+            f"| {sum(entries)} | {max_entries} | {fallbacks} |"
+        )
+    if not rows:
+        return ""
+    return (
+        "### Aggregation overlay (bundle hops per node)\n\n"
+        "| node | bundles | vote | timeout | entries shipped | "
+        "largest bundle | fallbacks |\n"
+        "|---|---|---|---|---|---|---|\n" + "\n".join(rows)
+    )
+
+
 def ingress_leg_table(nodes: list[dict]) -> str:
     """Per-transaction ingress legs, aggregated: admission
     (ingress.recv -> ingress.admit) and queue+verify
@@ -378,6 +426,20 @@ def chrome_trace(nodes: list[dict]) -> dict:
                 "args": {"name": "ingress"},
             }
         )
+        # Aggregation-overlay bundle hops get their own lane too (tid
+        # well above the device-slot rows, which start at 2).
+        if any(
+            (e.get("kind") or "").startswith("agg.") for e in rec["events"]
+        ):
+            events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": _AGG_TID,
+                    "args": {"name": "aggregation"},
+                }
+            )
         # Device-timeline rows (ops/timeline.py): per-chunk stage/upload/
         # dispatch/readback slices, so transfer vs compute overlap is
         # visible beside the six-stage block rows. Under the dispatch
@@ -445,11 +507,16 @@ def chrome_trace(nodes: list[dict]) -> dict:
             if e.get("trace"):
                 args["trace"] = e["trace"]
             kind = e.get("kind", "?")
+            tid = 0
+            if kind.startswith("ingress."):
+                tid = 1
+            elif kind.startswith("agg."):
+                tid = _AGG_TID
             entry = {
                 "name": kind,
                 "cat": "hotstuff",
                 "pid": pid,
-                "tid": 1 if kind.startswith("ingress.") else 0,
+                "tid": tid,
                 "args": args,
             }
             dur = e.get("dur")
@@ -497,6 +564,7 @@ def main(argv: list[str] | None = None) -> int:
     print(latency_table(blocks))
     for section in (
         verify_lane_table(nodes),
+        agg_bundle_table(nodes),
         ingress_leg_table(nodes),
         device_timeline_table(nodes),
     ):
