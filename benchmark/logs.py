@@ -202,6 +202,11 @@ def _parse_node(text: str) -> dict:
             r"MATRIX worst regression: (\S+) commit rate ([+-]?[\d.]+)%", text
         )
     ]
+    # Static-analysis summary line (tools/graftlint): deploy/CI recipes
+    # run the lint before boot and tee its summary into the log. The
+    # LAST line wins (a rerun supersedes); absent on unlinted runs.
+    lint = _search_all(r"graftlint: (\d+) findings", text)
+    out["graftlint_findings"] = int(lint[-1]) if lint else None
     occ = _search_all(
         r"TELEMETRY device occupancy ([\d.]+)% overlap headroom ([\d.]+)%",
         text,
@@ -312,6 +317,9 @@ class LogParser:
         self.matrix_worst: list[tuple[str, float]] = []
         # (occupancy %, overlap headroom %) per node that logged telemetry
         self.occupancies: list[tuple[float, float]] = []
+        # Worst graftlint finding count across nodes; None when no node
+        # log carried the summary line.
+        self.graftlint_findings: int | None = None
         # Final METRICS snapshot per node (utils/metrics.py), and the
         # cross-node aggregate (counters summed, histogram count/sum summed).
         self.node_metrics: list[dict] = []
@@ -346,6 +354,12 @@ class LogParser:
             self.matrix_worst.extend(r.get("matrix_worst", []))
             if r.get("occupancy") is not None:
                 self.occupancies.append(r["occupancy"])
+            if r.get("graftlint_findings") is not None:
+                self.graftlint_findings = (
+                    r["graftlint_findings"]
+                    if self.graftlint_findings is None
+                    else max(self.graftlint_findings, r["graftlint_findings"])
+                )
             if r.get("metrics") is not None:
                 self.node_metrics.append(r["metrics"])
         self.metrics = self._merge_metrics(self.node_metrics)
@@ -599,7 +613,19 @@ class LogParser:
                     f" worst start lag {max(self.range_lags)} rounds,"
                     f" {self.range_blocks} blocks fetched\n"
                 )
+        lint = ""
+        if self.graftlint_findings is not None:
+            lint = (
+                " + LINT:\n"
+                f" graftlint: {self.graftlint_findings} findings\n"
+            )
         warn = ""
+        if self.graftlint_findings:
+            warn += (
+                f" WARNING: graftlint reported {self.graftlint_findings} "
+                "finding(s) — the deployed tree violates committed "
+                "contracts\n"
+            )
         if self.misses:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"
         if self.timeouts > 2:
@@ -637,6 +663,7 @@ class LogParser:
             )
             + ingress
             + telemetry
+            + lint
             + matrix
             + agg
             + reconfig

@@ -799,6 +799,7 @@ class ChaosOrchestrator:
             # against real per-node dumps like any recorder dump.
             "trace_anchor": {
                 "mono": asyncio.get_running_loop().time(),
+                # graftlint: allow[determinism] report metadata stamp, not replayed state
                 "wall": time.time(),
             },
             "watchdog_dumps": getattr(self, "watchdog_dumps", []),

@@ -1051,7 +1051,7 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 # (CHAOS_MATRIX_rN.json) is the regression harness for every scale claim
 # the ROADMAP makes. Grid scenarios must be COMMITTEE-SIZE-INVARIANT:
 # faults expressed as per-link defaults or single-node crash windows, no
-# hardcoded committee subsets (tools/lint_metrics.py lint_matrix enforces
+# hardcoded committee subsets (the graftlint `matrix` pass enforces
 # both that every name resolves here and that none pins a committee).
 # timeout_storm / timeout_storm_legacy are ISSUE 13's storm cells: the
 # same size-parameterized half|half stall with the overlay on vs off, so

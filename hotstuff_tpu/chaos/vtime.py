@@ -83,6 +83,7 @@ def run(coro, timeout: float | None = None, wall_timeout: float | None = None):
         main = coro
         if timeout is not None:
             main = asyncio.wait_for(coro, timeout)
+        # graftlint: allow[task-hygiene] loop bootstrap: run_until_complete + the wall watchdog own this task; no loop is running yet for actors.spawn to query
         task = loop.create_task(main)
         fired = threading.Event()  # explicit: is_alive() races the thread exit
         if wall_timeout is not None:

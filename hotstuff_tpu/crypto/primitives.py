@@ -176,6 +176,7 @@ def generate_keypair(rng) -> KeyPair:
 
 def generate_production_keypair() -> KeyPair:
     """OS-entropy keypair (crypto/src/lib.rs:161-164)."""
+    # graftlint: allow[determinism] production entropy by contract; seeded paths use generate_keypair(rng)
     return _keypair_from_seed(os.urandom(32))
 
 

@@ -180,7 +180,8 @@ _M_PREEMPT_CLOSES = metrics.counter("scheduler.preempt_closes")
 _M_DEPTH = metrics.gauge("scheduler.depth")
 _M_BUCKET_SIZE = metrics.histogram("scheduler.bucket_size", metrics.SIZE_BUCKETS)
 # Per-lane queueing delay (submit -> dequeue-into-a-bucket). The f-string
-# keeps lane names and histogram rows in lockstep; tools/lint_metrics.py
+# keeps lane names and histogram rows in lockstep; the graftlint
+# `scheduler` pass (python -m tools.graftlint)
 # separately asserts every registered class has its row in the canonical
 # namespace (the starvation lint's schema half).
 _QUEUE_HIST = {
@@ -611,7 +612,7 @@ class DeviceScheduler:
 
 
 # ---------------------------------------------------------------------------
-# Starvation lint support (tools/lint_metrics.py)
+# Starvation lint support (the graftlint `scheduler` pass)
 
 
 class _StubGroup:
@@ -635,7 +636,8 @@ def drain_order(classes: tuple[SourceClass, ...] | None = None) -> list[str]:
     with NO further arrivals, advancing a synthetic clock past each pending
     deadline, and return the lane names in the order their groups were
     dequeued. A registered class missing from the result can be enqueued
-    but never selected — the starvation condition tools/lint_metrics.py
+    but never selected — the starvation condition the graftlint
+    `scheduler` pass
     fails the build on (rc 1)."""
     sched = DeviceScheduler(lambda groups, total, critical: None)
     classes = classes or tuple(SOURCE_CLASSES.values())

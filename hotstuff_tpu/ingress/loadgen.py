@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Awaitable, Callable
 
 from ..utils import metrics
+from ..utils.actors import spawn
 from . import messages
 from .admission import IngressConfig
 from .messages import ClientTransaction, IngressResponse
@@ -180,7 +181,13 @@ class OpenLoopLoadGen:
             for _ in range(n):
                 tx = self._make_tx()
                 self.offered += 1
-                task = asyncio.ensure_future(self._one(tx, loop.time()))
+                # actors.spawn, not bare ensure_future: in-process chaos
+                # runs the generator inside a node-side SpawnScope, and a
+                # crash-cancel must take the in-flight submissions with it.
+                task = spawn(
+                    self._one(tx, loop.time()),
+                    name=f"{self.label}-tx{self.offered}",
+                )
                 self._inflight.add(task)
                 task.add_done_callback(self._inflight.discard)
             next_tick += TICK_S

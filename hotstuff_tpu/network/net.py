@@ -32,6 +32,7 @@ replace.
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import random
 import struct
@@ -59,6 +60,24 @@ _M_BACKOFF_SECONDS = metrics.counter("net.backoff_seconds")
 _M_BACKOFF_DROPS = metrics.counter("net.backoff_drops")
 
 MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
+
+
+def backoff_jitter_rng(node: object, sender: str, addr: Address) -> random.Random:
+    """Per-(node, sender, peer) seeded jitter stream for connect backoff —
+    the chaos `SeededRng.stream` idiom (hash a stable name, seed a
+    Random). `node` is the tracing NODE_LABEL (the chaos runner's node
+    index; the store name in a real node process — node/main.py sets
+    it), NOT just the sender's role name: every node names its sender
+    "consensus-sender", so a role-only seed would hand all n-1 nodes
+    retrying one recovering peer the SAME jitter sequence — a lockstep
+    reconnect stampede, the exact failure jitter exists to prevent.
+    With node identity in the seed every draw stays a pure function of
+    stable identity (bit-identical under chaos replay) while distinct
+    nodes keep decorrelated retry clocks."""
+    digest = hashlib.sha256(
+        f"net-backoff:{node}:{sender}:{addr[0]}:{addr[1]}".encode()
+    ).digest()
+    return random.Random(int.from_bytes(digest[:8], "big"))
 
 # ---------------------------------------------------------------------------
 # Pluggable transport (the chaos subsystem's fault-injection seam).
@@ -272,6 +291,10 @@ class NetSender:
         selector = Selector()
         selector.add("hot", hot.get)
         selector.add("cold", cold.get, priority=1)
+        # The worker task inherits the node's NODE_LABEL contextvar
+        # (orchestrator sets an index per in-process node; node/main.py
+        # sets the store name per process).
+        jitter = backoff_jitter_rng(tracing.NODE_LABEL.get(), self._name, addr)
         writer: asyncio.StreamWriter | None = None
         connected_before = False  # reconnects = churn, not initial connects
         backoff = 0.0  # current backoff window (s); 0 = healthy
@@ -299,9 +322,12 @@ class NetSender:
                     # BACKOFF_MAX_S is a true bound: jitter decorrelates the
                     # retry clocks of many senders all aimed at one
                     # recovering peer (no reconnect stampede at heal time).
+                    # Drawn from the per-(sender, peer) seeded stream, not
+                    # the ambient `random` module, so a chaos replay sees
+                    # the identical backoff schedule.
                     backoff = min(
                         max(2 * backoff, self.BACKOFF_BASE_S)
-                        * (0.5 + random.random()),
+                        * (0.5 + jitter.random()),
                         self.BACKOFF_MAX_S,
                     )
                     next_attempt = loop.time() + backoff

@@ -6,7 +6,7 @@ The jax-backed submodules (`field`, `ed25519`, ...) load LAZILY (PEP 562):
 `hotstuff_tpu.ops.timeline` (device-occupancy timeline) and
 `hotstuff_tpu.ops.pipeline` (async dispatch pipeline) plus the two
 relay/cache helpers below are dependency-free, and the telemetry plane,
-chaos runner, and tools/lint_metrics.py import them on hosts with no jax
+chaos runner, and the graftlint tool import them on hosts with no jax
 at all. `from hotstuff_tpu.ops import ed25519 as ed` still works unchanged
 (submodule imports bypass this shim); only attribute access on the package
 goes through __getattr__.

@@ -45,7 +45,8 @@ The pipeline stamps the `stage` and `readback` phases of each task's
 DeviceTimeline key; the task's `submit` callable owns the `upload` and
 `dispatch` phases (the existing `_upload_dispatch` /
 `_upload_dispatch_committee` seams, which the mesh verifier overrides).
-`TIMELINE_STAGES` is the full vocabulary — tools/lint_metrics.py asserts
+`TIMELINE_STAGES` is the full vocabulary — the graftlint `pipeline`
+pass asserts
 it stays inside `timeline.PHASES` so trace_report.py's device rows keep
 rendering.
 
@@ -81,7 +82,7 @@ __all__ = [
 
 # Every DeviceTimeline phase a DispatchPipeline run can stamp (directly —
 # stage/readback — or through its tasks' submit callables — upload/
-# dispatch). tools/lint_metrics.py fails the build if this set ever
+# dispatch). The graftlint `pipeline` pass fails the build if this ever
 # leaves timeline.PHASES: a renamed stage would silently fall out of the
 # occupancy/headroom math and the trace_report device rows.
 TIMELINE_STAGES: tuple[str, ...] = ("stage", "upload", "dispatch", "readback")

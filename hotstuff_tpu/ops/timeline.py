@@ -35,7 +35,7 @@ anchor pair so `tools/trace_report.py` can align device-timeline rows
 beside the six-stage block rows.
 
 Dependency-free by design: stdlib + utils.metrics/tracing only — no jax
-(`tools/lint_metrics.py` and the chaos/telemetry planes import this
+(the graftlint tool and the chaos/telemetry planes import this
 module on hosts with no accelerator stack at all).
 """
 
@@ -239,6 +239,7 @@ class DeviceTimeline:
             "capacity": self.capacity,
             "recorded": self._count,
             "dropped": self.dropped,
+            # graftlint: allow[determinism] dump-alignment stamp, mirrors the flight recorder's (mono, wall) anchor
             "anchor": {"mono": time.monotonic(), "wall": time.time()},
             "intervals": self.intervals(),
             "summary": self.summary(),

@@ -524,7 +524,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("crypto.batch_size", "histogram", SIZE_BUCKETS),
     # crypto/scheduler.py — continuous-batching device scheduler. One
     # queue-delay histogram PER REGISTERED SOURCE CLASS: the starvation
-    # lint (tools/lint_metrics.py) fails if a class in
+    # lint (the graftlint `scheduler` pass) fails if a class in
     # scheduler.SOURCE_CLASSES has no row here.
     ("scheduler.submitted", "counter", None),
     ("scheduler.dispatched_groups", "counter", None),

@@ -47,7 +47,8 @@ leading up to the trigger, not just the event ring.
 
 Dependency-free by design: stdlib + utils.metrics/tracing (network.net
 imported lazily inside the server/client) — no jax, importable everywhere
-the chaos runner and tools/lint_metrics.py run.
+the chaos runner and the graftlint tool run (the import-boundary
+pass pins it statically).
 """
 
 from __future__ import annotations
@@ -97,7 +98,8 @@ class SLOSpec:
     """One latency objective the telemetry plane evaluates.
 
     `metric` MUST name a histogram row in the canonical metrics namespace
-    (tools/lint_metrics.py enforces this, rc 1). With `lane` set, events
+    (the graftlint `telemetry` pass enforces this, rc 1). With `lane`
+    set, events
     come from the attached LaneStats lane instead (per-service, fresh per
     run — the scheduler lane SLOs); otherwise from the global histogram's
     bucket-count deltas (a delta bucket counts as violating when its
@@ -116,7 +118,7 @@ def default_slos() -> tuple[SLOSpec, ...]:
     """The evaluated SLO set of record: one lane SLO per registered
     scheduler source class (threshold = the class's published slo_s —
     PR 7's advisory strings, now enforced) plus the device verify-latency
-    target. tools/lint_metrics.py fails the build if a registered source
+    target. The graftlint `telemetry` pass fails the build if a source
     class is missing from this set."""
     from ..crypto.scheduler import SOURCE_CLASSES
 
@@ -569,6 +571,7 @@ class TelemetryPlane:
             "kind": "telemetry",
             "node": self.label,
             "interval_s": self.config.interval_s,
+            # graftlint: allow[determinism] cross-process alignment stamp in scrape metadata; excluded from bit-identity checks
             "anchor": {"mono": self._clock(), "wall": time.time()},
             "snapshots": snaps,
             "alerts": list(self.alerts),
@@ -922,16 +925,14 @@ def serve_in_thread(
             started.set()
             if snapshot_interval_s and isinstance(source, TelemetryPlane):
                 source.config.interval_s = snapshot_interval_s
-                task = asyncio.ensure_future(source.run())
-                # A snapshot exception must not silently freeze the ring
-                # while scrapes keep serving stale rc-0 data.
-                task.add_done_callback(
-                    lambda t: t.cancelled()
-                    or t.exception() is None
-                    or log.error(
-                        "telemetry snapshot loop died: %r", t.exception()
-                    )
-                )
+                # actors.spawn (not bare ensure_future): keeps a strong
+                # reference, adopts the loop into any ambient SpawnScope,
+                # and its done-callback already ERROR-logs a crashed
+                # snapshot loop — the ring must not silently freeze while
+                # scrapes keep serving stale rc-0 data.
+                from .actors import spawn
+
+                spawn(source.run(), name="telemetry-snapshots")
             async with server._server:
                 await server._server.serve_forever()
 

@@ -42,7 +42,8 @@ the deterministic replay. Dumps carry a (mono, wall) anchor pair so
 
 Canonical stage vocabulary: the six per-block lifecycle stages
 (`STAGES`) plus the auxiliary event kinds (`EVENT_KINDS`). Like the
-metric namespace, this is the schema of record — `tools/lint_metrics.py`
+metric namespace, this is the schema of record — the graftlint
+`namespace` pass
 fails any string-literal `tracing.event` kind that is not registered
 here.
 
@@ -361,6 +362,7 @@ class FlightRecorder:
             "capacity": self.capacity,
             "recorded": self._count,
             "dropped": self.dropped,
+            # graftlint: allow[determinism] dump-alignment stamp (merges per-process dumps onto one wall timeline)
             "anchor": {"mono": _clock(), "wall": time.time()},
             "events": self.events(node=node),
         }

@@ -279,6 +279,39 @@ def test_log_parser_surfaces_watchdog_firings():
     assert "1 recorder dump(s)" in out
 
 
+def test_log_parser_scrapes_graftlint_summary():
+    """The static-analysis summary line (tools/graftlint) surfaces as a
+    LINT section; a nonzero count also warns. The LAST line per node
+    wins and the WORST node count is reported; absent on unlinted runs."""
+    from benchmark.logs import LogParser
+
+    quiet = LogParser([CLIENT_LOG], [NODE_LOG])
+    assert quiet.graftlint_findings is None
+    assert "+ LINT" not in quiet.result()
+
+    clean = NODE_LOG + (
+        "[2026-07-30T10:00:00.500Z INFO hotstuff.node] graftlint: 0 "
+        "findings (6 pragma-allowed, 9 baselined, 10 passes)\n"
+    )
+    dirty = NODE_LOG + (
+        "[2026-07-30T10:00:00.400Z INFO hotstuff.node] graftlint: 7 "
+        "findings (0 pragma-allowed, 0 baselined, 10 passes)\n"
+        "[2026-07-30T10:00:00.500Z INFO hotstuff.node] graftlint: 3 "
+        "findings (0 pragma-allowed, 0 baselined, 10 passes)\n"
+    )
+    p = LogParser([CLIENT_LOG], [clean])
+    assert p.graftlint_findings == 0
+    out = p.result()
+    assert " + LINT:\n graftlint: 0 findings\n" in out
+    assert "WARNING: graftlint" not in out
+
+    p = LogParser([CLIENT_LOG], [clean, dirty])
+    assert p.graftlint_findings == 3  # last line per node, worst node
+    out = p.result()
+    assert "graftlint: 3 findings" in out
+    assert "WARNING: graftlint reported 3 finding(s)" in out
+
+
 # ---------------------------------------------------------------------------
 # LogParser: METRICS snapshot scraping (utils/metrics.py periodic emitter)
 

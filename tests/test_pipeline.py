@@ -328,9 +328,15 @@ def test_dropped_pipeline_is_reaped_by_finalizer():
     assert not [t for t in threading.enumerate() if "test-leak" in t.name]
 
 
+@pytest.mark.slow
 def test_pipeline_importable_without_jax():
     """ops.pipeline must import on a jax-less host (the lint and the
-    scheduler's steal accounting depend on it), like ops.timeline."""
+    scheduler's steal accounting depend on it), like ops.timeline.
+
+    Slow tier: the contract is pinned statically in tier-1 by
+    graftlint's import-boundary pass (a transitive walk of the runtime
+    import graph — tests/test_graftlint.py), so this subprocess smoke
+    is the belt-and-braces runtime proof, not the gate."""
     code = (
         "import sys; sys.modules['jax'] = None; sys.modules['jaxlib'] = None\n"
         "from hotstuff_tpu.ops import pipeline, timeline\n"

@@ -192,9 +192,15 @@ def test_deferred_readback_masks_bit_identical():
     assert bool(want[0]) and not bool(want[5]) and not bool(want[100])
 
 
+@pytest.mark.slow
 def test_timeline_importable_without_jax():
-    """The lint contract: ops.timeline (and the lazified ops package) must
-    import on a host with no jax at all — DeviceScheduler's rule."""
+    """The lint contract: ops.timeline (and the lazified ops package, and
+    telemetry + the scheduler behind default_slos) must import on a host
+    with no jax at all — DeviceScheduler's rule.
+
+    Slow tier: graftlint's import-boundary pass pins the same contract
+    statically in tier-1 (tests/test_graftlint.py), so this subprocess
+    smoke is the belt-and-braces runtime proof, not the gate."""
     code = (
         "import sys; sys.modules['jax'] = None; sys.modules['jaxlib'] = None\n"
         "from hotstuff_tpu.ops import timeline\n"

@@ -1,0 +1,7 @@
+"""Repo tooling package marker (lets `python -m tools.graftlint` resolve).
+
+The scripts in this directory remain directly runnable
+(`python tools/chaos_run.py ...`); the package marker only exists so the
+static-analysis framework under `tools/graftlint/` is importable as a
+module from the repo root.
+"""

@@ -1,0 +1,48 @@
+"""graftlint: the repo's ONE static-analysis entrypoint.
+
+    python -m tools.graftlint                 # whole production tree
+    python -m tools.graftlint --select determinism,task-hygiene
+    python -m tools.graftlint --ignore namespace
+    python -m tools.graftlint --json          # stable, sorted, diffable
+    python -m tools.graftlint --list          # pass catalog
+    python -m tools.graftlint --write-baseline
+
+Exit codes: 0 = clean, 1 = findings, 2 = usage error.
+
+The framework (core.py) parses each file ONCE and shares the AST, the
+text, and the static import graph across every registered pass; the
+whole tree lints in seconds on a 1-core box. Passes:
+
+  * determinism     — entropy / wall-clock / set-order reads inside
+                      chaos-reachable modules (import graph rooted at
+                      `chaos/` + `consensus/`)
+  * task-hygiene    — bare `create_task`/`ensure_future` outside
+                      utils/actors.py, `time.sleep` in `async def`,
+                      un-awaited coroutine calls
+  * import-boundary — declared jax-free / cryptography-free modules
+                      verified by a transitive runtime-import walk
+                      (replaces the subprocess import smokes)
+  * wire-schema     — frame-tag uniqueness per codec module, digest
+                      domain-separation uniqueness repo-wide
+  * namespace, scheduler, telemetry, pipeline, scenarios, matrix —
+                      the six lints folded in from tools/lint_metrics.py
+                      (which remains as a thin back-compat shim)
+
+Suppression: inline `# graftlint: allow[pass-id] <reason>` pragmas for
+principled exemptions (reason mandatory), and the committed
+`tools/graftlint/baseline.txt` for grandfathered sites. The baseline
+must stay EMPTY for `hotstuff_tpu/consensus/` and `hotstuff_tpu/chaos/`
+(tests/test_graftlint.py pins that): determinism debt is not allowed
+where replay is the product.
+
+COMPONENTS.md §5.5m documents the pass catalog, the reachability rules,
+and the pragma/baseline grammar.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    RunResult,
+    collect_sources,
+    load_baseline,
+    run_passes,
+)

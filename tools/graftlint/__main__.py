@@ -1,0 +1,144 @@
+"""CLI for graftlint (see tools/graftlint/__init__.py for the contract)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..")
+)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tools.graftlint import core  # noqa: E402
+
+
+def _csv(values: list[str]) -> set[str]:
+    out: set[str] = set()
+    for v in values:
+        out.update(p.strip() for p in v.split(",") if p.strip())
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="AST-based contract checker (see tools/graftlint/).",
+    )
+    ap.add_argument(
+        "--root",
+        default=_REPO_ROOT,
+        help="tree to scan (default: the repo root)",
+    )
+    ap.add_argument(
+        "--select",
+        action="append",
+        default=[],
+        metavar="PASS[,PASS]",
+        help="run only these passes",
+    )
+    ap.add_argument(
+        "--ignore",
+        action="append",
+        default=[],
+        metavar="PASS[,PASS]",
+        help="skip these passes",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=None,
+        help="baseline file (default: <root>/tools/graftlint/baseline.txt; "
+        "'none' disables)",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable, stable output"
+    )
+    ap.add_argument(
+        "--list", action="store_true", help="list registered passes and exit"
+    )
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    if not os.path.isdir(root):
+        print(f"not a directory: {args.root}", file=sys.stderr)
+        return 2
+    if args.write_baseline and (args.select or args.ignore):
+        # A baseline regenerated from a pass subset would silently drop
+        # every OTHER pass's grandfathered entries — refuse.
+        print(
+            "usage error: --write-baseline regenerates the whole baseline "
+            "and cannot be combined with --select/--ignore",
+            file=sys.stderr,
+        )
+        return 2
+    if args.write_baseline and args.baseline == "none":
+        print(
+            "usage error: --write-baseline needs a baseline path "
+            "(--baseline none disables the baseline)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.list:
+        # Importing the pass modules populates the registry.
+        from tools.graftlint import (  # noqa: F401
+            determinism,
+            import_boundary,
+            metrics_passes,
+            task_hygiene,
+            wire_schema,
+        )
+
+        for p in sorted(core.PASSES.values(), key=lambda p: p.id):
+            print(f"{p.id:16s} {p.doc}")
+        return 0
+
+    baseline_path = args.baseline or os.path.join(
+        root, "tools", "graftlint", "baseline.txt"
+    )
+    baseline: set[str] = set()
+    if args.baseline != "none" and not args.write_baseline:
+        baseline = core.load_baseline(baseline_path)
+
+    try:
+        result = core.run_passes(
+            root,
+            select=_csv(args.select) or None,
+            ignore=_csv(args.ignore) or None,
+            baseline=baseline,
+        )
+    except KeyError as e:
+        print(f"usage error: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        by_rel = result.sources_by_rel or {}
+        keys = sorted(
+            {core.baseline_key(f, by_rel.get(f.path)) for f in result.findings}
+        )
+        os.makedirs(os.path.dirname(baseline_path) or ".", exist_ok=True)
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(core.BASELINE_HEADER)
+            for k in keys:
+                f.write(k + "\n")
+        print(f"baseline written: {len(keys)} entries -> {baseline_path}")
+        return 0
+
+    if args.json:
+        print(result.to_json())
+    else:
+        for f in result.findings:
+            print(f.render(), file=sys.stderr)
+        print(result.summary_line())
+    return 1 if result.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

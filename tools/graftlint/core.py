@@ -1,0 +1,477 @@
+"""graftlint core: one-parse-per-file AST framework, pass registry,
+pragmas, baseline, and the run loop.
+
+Design rules (tools/graftlint/__init__.py has the user-facing contract):
+
+  * ONE `ast.parse` per file, shared by every pass through `Source` —
+    a lint run over the whole production tree must stay in seconds on a
+    1-core box, so passes never re-read or re-parse.
+  * stdlib only, jax-free, cryptography-free: the lint runs on hosts
+    that have neither (and the import-boundary pass holds the lint
+    itself to that contract).
+  * Findings are DATA (pass id, repo-relative path, 1-based line,
+    message) so `--json` output is stable and diffable: the sort order
+    is total and content-derived, never dict/iteration order.
+
+Suppression layers, outermost first:
+
+  * `# graftlint: allow[pass-id] <reason>` pragma on the offending line
+    (or alone on the line above) — the principled, reviewed exemption.
+    A pragma without a reason is itself a finding (`pragma` pass): an
+    unexplained suppression is a future archaeology job.
+  * the committed baseline file (`tools/graftlint/baseline.txt`) — bulk
+    grandfathered sites, keyed by (pass, path, stripped source line) so
+    entries survive line drift. New code must not grow the baseline;
+    `--write-baseline` regenerates it deliberately.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+
+# Directories never scanned (vendored data, caches, VCS, fixture-heavy
+# test tree — test files legitimately CONTAIN the idioms the passes
+# reject, as string fixtures and as negative-path code).
+SKIP_DIRS = {
+    "__pycache__",
+    ".git",
+    ".claude",
+    "data",
+    "native",
+    "tests",
+    "node_modules",
+}
+
+_PRAGMA = re.compile(r"#\s*graftlint:\s*allow\[([a-z0-9_*,-]+)\]\s*(.*)$")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One violation. Ordering is total and content-derived: `--json`
+    output diffs meaningfully across runs and hosts."""
+
+    path: str  # repo-root-relative, '/'-separated
+    line: int  # 1-based; 1 for module-level findings
+    pass_id: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "pass": self.pass_id,
+            "message": self.message,
+        }
+
+
+class Source:
+    """One parsed file: text, lines, AST (None on syntax error — the
+    `parse` pseudo-pass reports those), dotted module name, pragmas."""
+
+    def __init__(self, root: str, rel: str) -> None:
+        self.rel = rel.replace(os.sep, "/")
+        self.abspath = os.path.join(root, rel)
+        # errors="replace": a stray non-UTF8 byte must surface as ONE
+        # parse finding for that file, never crash the whole run.
+        with open(self.abspath, encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        self.lines = self.text.splitlines()
+        self.module = _module_name(self.rel)
+        self.is_init = os.path.basename(self.rel) == "__init__.py"
+        try:
+            self.tree: ast.Module | None = ast.parse(self.text)
+        except SyntaxError as e:
+            self.tree = None
+            self.syntax_error = f"{e.msg} (line {e.lineno})"
+        else:
+            self.syntax_error = None
+        # line -> set of pass ids allowed there ('*' = all), plus the
+        # pragma findings (missing reason) discovered while parsing.
+        self.allow: dict[int, set[str]] = {}
+        self.pragma_findings: list[Finding] = []
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(line)
+            if not m:
+                continue
+            passes = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            reason = m.group(2).strip()
+            if not reason:
+                self.pragma_findings.append(
+                    Finding(
+                        self.rel,
+                        i,
+                        "pragma",
+                        "allow[] pragma without a reason — state why the "
+                        "site is exempt (the reason is the review record)",
+                    )
+                )
+                continue
+            # A pragma alone on its line covers the NEXT line; an inline
+            # pragma covers its own line.
+            code = line[: m.start()].strip()
+            target = i if code else i + 1
+            self.allow.setdefault(target, set()).update(passes)
+
+    def allowed(self, pass_id: str, line: int) -> bool:
+        passes = self.allow.get(line)
+        return bool(passes) and (pass_id in passes or "*" in passes)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def _module_name(rel: str) -> str:
+    parts = rel[: -len(".py")].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# Import graph (shared by the determinism and import-boundary passes)
+
+
+def _is_import_gated(stack: list[ast.AST]) -> bool:
+    """True when the import sits under a try whose handler catches
+    ImportError/ModuleNotFoundError (or bare/Exception) — the sanctioned
+    optional-dependency gate (crypto/primitives.py's `cryptography`)."""
+    for node in reversed(stack):
+        if isinstance(node, ast.Try):
+            for h in node.handlers:
+                names = []
+                t = h.type
+                if t is None:
+                    return True
+                for n in t.elts if isinstance(t, ast.Tuple) else [t]:
+                    if isinstance(n, ast.Name):
+                        names.append(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        names.append(n.attr)
+                if {"ImportError", "ModuleNotFoundError", "Exception"} & set(
+                    names
+                ):
+                    return True
+    return False
+
+
+def _is_type_checking_if(node: ast.AST) -> bool:
+    if not isinstance(node, ast.If):
+        return False
+    t = node.test
+    return (isinstance(t, ast.Name) and t.id == "TYPE_CHECKING") or (
+        isinstance(t, ast.Attribute) and t.attr == "TYPE_CHECKING"
+    )
+
+
+@dataclass(frozen=True)
+class ImportSite:
+    target: str  # absolute dotted module ('jax', 'hotstuff_tpu.ops.timeline')
+    line: int
+    runtime: bool  # module-level (executes at import time), not lazy
+    gated: bool  # under a try/except ImportError
+
+
+class ImportGraph:
+    """Static import graph over the scanned tree. `sites[module]` holds
+    every import the module's AST contains; helpers project the graph
+    down to internal runtime edges (import-boundary) or all internal
+    edges (chaos reachability)."""
+
+    def __init__(self, sources: list[Source]) -> None:
+        self.by_module = {s.module: s for s in sources}
+        self.sites: dict[str, list[ImportSite]] = {
+            s.module: self._collect(s) for s in sources
+        }
+
+    def _collect(self, src: Source) -> list[ImportSite]:
+        if src.tree is None:
+            return []
+        out: list[ImportSite] = []
+        pkg = src.module if src.is_init else src.module.rpartition(".")[0]
+
+        def walk(node: ast.AST, stack: list[ast.AST], runtime: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_runtime = runtime and not isinstance(
+                    child,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda),
+                )
+                if _is_type_checking_if(child):
+                    child_runtime = False
+                if isinstance(child, ast.Import):
+                    for alias in child.names:
+                        out.append(
+                            ImportSite(
+                                alias.name,
+                                child.lineno,
+                                runtime,
+                                _is_import_gated(stack),
+                            )
+                        )
+                elif isinstance(child, ast.ImportFrom):
+                    base = child.module or ""
+                    if child.level:
+                        head = pkg.split(".") if pkg else []
+                        head = head[: len(head) - (child.level - 1)]
+                        base = ".".join(head + ([base] if base else []))
+                    gated = _is_import_gated(stack)
+                    out.append(
+                        ImportSite(base, child.lineno, runtime, gated)
+                    )
+                    for alias in child.names:
+                        sub = f"{base}.{alias.name}"
+                        if sub in self.by_module:
+                            out.append(
+                                ImportSite(sub, child.lineno, runtime, gated)
+                            )
+                else:
+                    walk(child, stack + [child], child_runtime)
+
+        walk(src.tree, [src.tree], True)
+        return out
+
+    def _internal_deps(
+        self, module: str, runtime_only: bool
+    ) -> set[str]:
+        deps: set[str] = set()
+        for site in self.sites.get(module, []):
+            if runtime_only and (not site.runtime or site.gated):
+                continue
+            # importing a.b.c executes a and a.b too
+            parts = site.target.split(".")
+            for i in range(1, len(parts) + 1):
+                cand = ".".join(parts[:i])
+                if cand in self.by_module:
+                    deps.add(cand)
+        # a module's ancestor packages execute whenever it is imported
+        parts = module.split(".")
+        for i in range(1, len(parts)):
+            cand = ".".join(parts[:i])
+            if cand in self.by_module:
+                deps.add(cand)
+        return deps
+
+    def reachable(
+        self, roots: set[str], runtime_only: bool = False
+    ) -> set[str]:
+        seen: set[str] = set()
+        frontier = [m for m in roots if m in self.by_module]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            frontier.extend(self._internal_deps(m, runtime_only) - seen)
+        return seen
+
+    def external_runtime_imports(
+        self, module: str, forbidden: set[str]
+    ) -> list[ImportSite]:
+        """Ungated module-level imports of `forbidden` top-level packages."""
+        hits = []
+        for site in self.sites.get(module, []):
+            if not site.runtime or site.gated:
+                continue
+            if site.target.split(".")[0] in forbidden:
+                hits.append(site)
+        return hits
+
+
+# ---------------------------------------------------------------------------
+# Pass registry
+
+
+@dataclass(frozen=True)
+class Pass:
+    id: str
+    doc: str
+    fn: object  # Callable[[Context], list[Finding]]
+
+
+PASSES: dict[str, Pass] = {}
+
+
+def register(pass_id: str, doc: str):
+    def deco(fn):
+        PASSES[pass_id] = Pass(pass_id, doc, fn)
+        return fn
+
+    return deco
+
+
+class Context:
+    """Everything a pass may consume: parsed sources, the import graph,
+    and the scan root. Built once per run."""
+
+    def __init__(self, root: str, sources: list[Source]) -> None:
+        self.root = root
+        self.sources = sources
+        self.graph = ImportGraph(sources)
+        self._chaos_reachable: set[str] | None = None
+
+    def sources_under(self, *prefixes: str) -> list[Source]:
+        return [
+            s
+            for s in self.sources
+            if any(s.rel.startswith(p) for p in prefixes)
+        ]
+
+    def chaos_reachable(self) -> set[str]:
+        """Modules on the static import graph (lazy imports included —
+        a lazily imported module still runs inside the replayed scenario)
+        rooted at every module under a `chaos/` or `consensus/` dir."""
+        if self._chaos_reachable is None:
+            roots = {
+                s.module
+                for s in self.sources
+                if re.search(r"(^|/)(chaos|consensus)/", s.rel)
+            }
+            self._chaos_reachable = self.graph.reachable(roots)
+        return self._chaos_reachable
+
+
+def collect_sources(root: str) -> list[Source]:
+    out: list[Source] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d not in SKIP_DIRS and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                out.append(Source(root, rel))
+    return out
+
+
+def load_baseline(path: str) -> set[str]:
+    if not os.path.isfile(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        return {
+            line.rstrip("\n")
+            for line in f
+            if line.strip() and not line.startswith("#")
+        }
+
+
+BASELINE_HEADER = (
+    "# graftlint baseline: grandfathered findings, one per line as\n"
+    "# <pass>\\t<path>\\t<stripped source line>. Regenerate deliberately\n"
+    "# with `python -m tools.graftlint --write-baseline`; new code must\n"
+    "# not grow this file, and hotstuff_tpu/consensus/ + hotstuff_tpu/\n"
+    "# chaos/ entries are forbidden (tests/test_graftlint.py pins that).\n"
+)
+
+
+def baseline_key(f: Finding, src: Source | None) -> str:
+    text = src.line_text(f.line) if src is not None else ""
+    return f"{f.pass_id}\t{f.path}\t{text}"
+
+
+@dataclass
+class RunResult:
+    findings: list[Finding]
+    suppressed_pragma: int
+    suppressed_baseline: int
+    passes_run: list[str]
+    # The parsed sources of the run, keyed by repo-relative path — lets
+    # --write-baseline compute keys without re-reading/re-parsing the
+    # tree (the one-parse-per-file rule applies to the CLI too).
+    sources_by_rel: dict[str, Source] | None = None
+
+    def summary_line(self) -> str:
+        # benchmark/logs.py scrapes this exact shape into run summaries.
+        return (
+            f"graftlint: {len(self.findings)} findings "
+            f"({self.suppressed_pragma} pragma-allowed, "
+            f"{self.suppressed_baseline} baselined, "
+            f"{len(self.passes_run)} passes)"
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "count": len(self.findings),
+                "findings": [f.to_json() for f in self.findings],
+                "passes": sorted(self.passes_run),
+                "suppressed": {
+                    "pragma": self.suppressed_pragma,
+                    "baseline": self.suppressed_baseline,
+                },
+            },
+            indent=2,
+            sort_keys=True,
+        )
+
+
+def run_passes(
+    root: str,
+    select: set[str] | None = None,
+    ignore: set[str] | None = None,
+    baseline: set[str] | None = None,
+) -> RunResult:
+    # Import for side effect: each pass module registers itself. Kept
+    # lazy so `import tools.graftlint.core` never drags repo imports in.
+    from . import (  # noqa: F401
+        determinism,
+        import_boundary,
+        metrics_passes,
+        task_hygiene,
+        wire_schema,
+    )
+
+    sources = collect_sources(root)
+    ctx = Context(root, sources)
+    by_rel = {s.rel: s for s in sources}
+
+    pass_ids = sorted(PASSES)
+    if select:
+        unknown = select - set(pass_ids)
+        if unknown:
+            raise KeyError(f"unknown pass(es): {sorted(unknown)}")
+        pass_ids = [p for p in pass_ids if p in select]
+    if ignore:
+        pass_ids = [p for p in pass_ids if p not in ignore]
+
+    raw: set[Finding] = set()  # identical findings collapse (e.g. two
+    # urandom calls on one line); Finding is frozen+ordered for this
+    # Structural findings outside any selectable pass: syntax errors and
+    # malformed pragmas are never suppressible.
+    for s in sources:
+        if s.syntax_error is not None:
+            raw.add(
+                Finding(s.rel, 1, "parse", f"syntax error: {s.syntax_error}")
+            )
+        raw.update(s.pragma_findings)
+    for pid in pass_ids:
+        raw.update(PASSES[pid].fn(ctx))
+
+    findings: list[Finding] = []
+    n_pragma = n_baseline = 0
+    baseline = baseline or set()
+    for f in sorted(raw):
+        src = by_rel.get(f.path)
+        if (
+            src is not None
+            and f.pass_id not in ("parse", "pragma")
+            and src.allowed(f.pass_id, f.line)
+        ):
+            n_pragma += 1
+            continue
+        if baseline_key(f, src) in baseline:
+            n_baseline += 1
+            continue
+        findings.append(f)
+    findings.sort()
+    return RunResult(findings, n_pragma, n_baseline, pass_ids, by_rel)

@@ -1,0 +1,246 @@
+"""determinism pass: nondeterminism sources inside chaos-reachable code.
+
+Bit-identical same-seed replay is a first-class protocol property here
+(the chaos plane asserts fault traces, commits, events and telemetry
+rings equal across back-to-back runs), so any module the chaos or
+consensus planes can reach — computed from the static import graph
+rooted at `chaos/` and `consensus/`, lazy imports included — must not
+read ambient entropy or ambient wall clocks on paths that feed wire or
+fault decisions. Flagged:
+
+  * wall-clock reads: `time.time()` / `time.time_ns()` /
+    `datetime.now()/utcnow()/today()`. Duration clocks
+    (`perf_counter`, `monotonic`) are NOT flagged: they are the
+    sanctioned observability clocks (metrics/tracing stamps), and the
+    loop clock (`loop.time()`) is the only clock protocol logic may
+    read — it is what the virtual-time loop virtualizes.
+  * unseeded module-level randomness: `random.random()` & friends and
+    `os.urandom()`. The clean idiom is a `random.Random` seeded from a
+    pure function of stable identity (the chaos `SeededRng.stream`
+    pattern, or `network/net.py`'s per-(sender, peer) backoff stream).
+  * set iteration: `for x in set(...)` / set displays / set
+    comprehensions as the iterable — iteration order is
+    hash-randomized across processes (PYTHONHASHSEED), so anything it
+    feeds diverges between a run and its replay. Sort first.
+
+Exemptions ride the standard ``allow[determinism] <reason>`` pragma for
+principled sites (report wall stamps, production-entropy key
+generation) and the baseline for grandfathered ones.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, Source, register
+
+# random.Random(seed) is the sanctioned idiom — but only the SEEDED
+# form: an arg-less Random() seeds from OS entropy, and SystemRandom is
+# OS entropy by construction; both are flagged below.
+_RANDOM_DRAWS = {
+    "random",
+    "randint",
+    "randrange",
+    "choice",
+    "choices",
+    "shuffle",
+    "sample",
+    "uniform",
+    "triangular",
+    "gauss",
+    "normalvariate",
+    "expovariate",
+    "getrandbits",
+    "randbytes",
+    "betavariate",
+    "paretovariate",
+    "vonmisesvariate",
+    "weibullvariate",
+    "lognormvariate",
+    "seed",
+}
+
+_WALL_CLOCK_TIME = {"time", "time_ns"}
+_WALL_CLOCK_DATETIME = {"now", "utcnow", "today"}
+
+
+def _module_aliases(tree: ast.Module, target: str) -> set[str]:
+    """Names the module `target` is bound to at any scope of this file
+    (`import random`, `import random as rnd`)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == target:
+                    names.add(alias.asname or target)
+    return names
+
+
+def _from_imports(tree: ast.Module, target: str) -> dict[str, str]:
+    """local name -> original name for `from target import x [as y]` at
+    any scope — the alias form `random.random()` checks alone would miss
+    (`from random import randint; randint(...)`)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.level == 0
+            and node.module == target
+        ):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _check_source(src: Source, findings: list[Finding]) -> None:
+    tree = src.tree
+    assert tree is not None
+    rnd = _module_aliases(tree, "random")
+    tim = _module_aliases(tree, "time")
+    osm = _module_aliases(tree, "os")
+    rnd_from = _from_imports(tree, "random")
+    tim_from = _from_imports(tree, "time")
+    os_from = _from_imports(tree, "os")
+    dt_from = _from_imports(tree, "datetime")
+
+    def flag(node: ast.AST, message: str) -> None:
+        findings.append(
+            Finding(src.rel, getattr(node, "lineno", 1), "determinism", message)
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            # from-import form: `from random import randint; randint(...)`
+            name = node.func.id
+            if rnd_from.get(name) == "SystemRandom":
+                flag(
+                    node,
+                    f"`{name}()` (from-imported random.SystemRandom) in a "
+                    "chaos-reachable module — OS entropy by construction, "
+                    "cannot replay; use a Random seeded by stable identity",
+                )
+            elif rnd_from.get(name) == "Random" and not node.args:
+                flag(
+                    node,
+                    f"arg-less `{name}()` (from-imported random.Random) in "
+                    "a chaos-reachable module seeds from OS entropy — pass "
+                    "a seed derived from stable identity",
+                )
+            elif rnd_from.get(name) in _RANDOM_DRAWS:
+                flag(
+                    node,
+                    f"unseeded `{name}()` (from-imported random."
+                    f"{rnd_from[name]}) in a chaos-reachable module — draw "
+                    "from a Random seeded by stable identity (the "
+                    "SeededRng stream idiom) so replays are bit-identical",
+                )
+            elif tim_from.get(name) in _WALL_CLOCK_TIME:
+                flag(
+                    node,
+                    f"wall-clock read `{name}()` (from-imported time."
+                    f"{tim_from[name]}) in a chaos-reachable module — "
+                    "protocol logic may only read the loop clock",
+                )
+            elif os_from.get(name) == "urandom":
+                flag(
+                    node,
+                    f"`{name}()` (from-imported os.urandom) in a "
+                    "chaos-reachable module — ambient entropy cannot "
+                    "replay; derive bytes from a seeded stream",
+                )
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            recv, attr = node.func.value, node.func.attr
+            if isinstance(recv, ast.Name):
+                if recv.id in rnd and attr == "SystemRandom":
+                    flag(
+                        node,
+                        f"`{recv.id}.SystemRandom()` in a chaos-reachable "
+                        "module — OS entropy by construction, cannot "
+                        "replay; use a Random seeded by stable identity",
+                    )
+                elif (
+                    recv.id in rnd and attr == "Random" and not node.args
+                ):
+                    flag(
+                        node,
+                        f"arg-less `{recv.id}.Random()` in a "
+                        "chaos-reachable module seeds from OS entropy — "
+                        "pass a seed derived from stable identity (the "
+                        "SeededRng stream idiom)",
+                    )
+                elif recv.id in rnd and attr in _RANDOM_DRAWS:
+                    flag(
+                        node,
+                        f"unseeded `{recv.id}.{attr}()` in a chaos-reachable "
+                        "module — draw from a Random seeded by stable "
+                        "identity (the SeededRng stream idiom) so replays "
+                        "are bit-identical",
+                    )
+                elif recv.id in tim and attr in _WALL_CLOCK_TIME:
+                    flag(
+                        node,
+                        f"wall-clock read `{recv.id}.{attr}()` in a "
+                        "chaos-reachable module — protocol logic may only "
+                        "read the loop clock (`loop.time()`, virtualized "
+                        "under replay); pragma report-stamp sites with a "
+                        "reason",
+                    )
+                elif recv.id in osm and attr == "urandom":
+                    flag(
+                        node,
+                        f"`{recv.id}.urandom()` in a chaos-reachable module "
+                        "— ambient entropy cannot replay; derive bytes from "
+                        "a seeded stream (pragma production-entropy sites "
+                        "with a reason)",
+                    )
+            # datetime.now() / datetime.datetime.now() / dt.now() where
+            # dt was from-imported out of the datetime module
+            if attr in _WALL_CLOCK_DATETIME:
+                dotted = ast.unparse(node.func)
+                head = dotted.split(".")[0]
+                if head == "datetime" or dt_from.get(head) in (
+                    "datetime",
+                    "date",
+                ):
+                    flag(
+                        node,
+                        f"wall-clock read `{dotted}()` in a chaos-reachable "
+                        "module — not replayable; use the loop clock or "
+                        "pragma with a reason",
+                    )
+        iters: list[ast.expr] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters.append(node.iter)
+        elif isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        ):
+            iters.extend(gen.iter for gen in node.generators)
+        for it in iters:
+            if (
+                isinstance(it, (ast.Set, ast.SetComp))
+                or (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")
+                )
+            ):
+                flag(
+                    it,
+                    "iterating a set in a chaos-reachable module — order is "
+                    "hash-randomized (PYTHONHASHSEED), so anything it feeds "
+                    "diverges under replay; iterate `sorted(...)` instead",
+                )
+
+
+@register(
+    "determinism",
+    "entropy/wall-clock/set-order reads inside chaos-reachable modules",
+)
+def run(ctx: Context) -> list[Finding]:
+    reachable = ctx.chaos_reachable()
+    findings: list[Finding] = []
+    for src in ctx.sources:
+        if src.tree is None or src.module not in reachable:
+            continue
+        _check_source(src, findings)
+    return findings

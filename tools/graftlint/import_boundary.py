@@ -1,0 +1,123 @@
+"""import-boundary pass: declared jax-free / cryptography-free modules,
+verified by a transitive walk of the RUNTIME import graph.
+
+Several modules are load-bearing on dependency-poor hosts: the device
+scheduler and its telemetry run where no jax wheel exists, the chaos
+plane signs with the pure-python signer on hosts without OpenSSL, and
+the lint tools themselves must run anywhere. Those contracts used to be
+enforced by subprocess import smokes (`sys.modules['jax'] = None` +
+import) that each cost tier-1 wall seconds and only covered the modules
+someone remembered to smoke; this pass walks the static import graph
+instead — module-level, un-gated imports only, since a lazy
+function-level import (the `ops/__init__` idiom) or a
+`try/except ImportError` gate (the `crypto/primitives` idiom) is
+exactly the sanctioned escape hatch.
+
+A violation is reported at the offending import line, with the chain
+from the declared module that reaches it.
+"""
+
+from __future__ import annotations
+
+import re
+
+from .core import Context, Finding, register
+
+# Declared contracts: dotted module (or a (regex, note) for families) ->
+# forbidden top-level packages. Modules listed but absent from the scan
+# root are skipped, so fixture trees can exercise the pass in isolation.
+_JAX = {"jax", "jaxlib"}
+_CRYPTO = {"cryptography"}
+
+DECLARED: list[tuple[str, frozenset[str], str]] = [
+    # (module-or-regex, forbidden packages, why)
+    ("hotstuff_tpu.ops.pipeline", frozenset(_JAX), "DeviceScheduler rule"),
+    ("hotstuff_tpu.ops.timeline", frozenset(_JAX), "DeviceScheduler rule"),
+    ("hotstuff_tpu.crypto.scheduler", frozenset(_JAX), "jax-less hosts"),
+    ("hotstuff_tpu.utils.telemetry", frozenset(_JAX), "jax-less hosts"),
+    (
+        "hotstuff_tpu.crypto.pysigner",
+        frozenset(_JAX | _CRYPTO),
+        "dependency-free signer",
+    ),
+    (
+        r"re:(^|\.)chaos(\.|$)",
+        frozenset(_JAX | _CRYPTO),
+        "chaos plane runs on pysigner on dependency-poor hosts",
+    ),
+    (
+        r"re:^tools\.(graftlint(\.|$)|lint_metrics$)",
+        frozenset(_JAX),
+        "the lint runs on jax-less hosts",
+    ),
+]
+
+
+def _declared_modules(ctx: Context) -> list[tuple[str, frozenset[str], str]]:
+    out = []
+    modules = set(ctx.graph.by_module)
+    for decl, forbidden, why in DECLARED:
+        if decl.startswith("re:"):
+            pat = re.compile(decl[3:])
+            out.extend(
+                (m, forbidden, why) for m in sorted(modules) if pat.search(m)
+            )
+        elif decl in modules:
+            out.append((decl, forbidden, why))
+    return out
+
+
+@register(
+    "import-boundary",
+    "jax-free / cryptography-free module contracts via the runtime import graph",
+)
+def run(ctx: Context) -> list[Finding]:
+    graph = ctx.graph
+    findings: list[Finding] = []
+    # Multi-source BFS per forbidden-set: declared families overlap
+    # heavily (every chaos module shares most of its runtime closure), so
+    # each offending import is reported ONCE, attributed to the first
+    # declared root (in sorted order) whose walk reaches it.
+    by_forbidden: dict[frozenset[str], list[tuple[str, str]]] = {}
+    for decl, forbidden, why in _declared_modules(ctx):
+        by_forbidden.setdefault(forbidden, []).append((decl, why))
+    for forbidden, decls in sorted(
+        by_forbidden.items(), key=lambda kv: sorted(kv[0])
+    ):
+        parent: dict[str, str | None] = {}
+        root_of: dict[str, tuple[str, str]] = {}
+        frontier: list[str] = []
+        for decl, why in sorted(decls):
+            if decl not in parent:
+                parent[decl] = None
+                root_of[decl] = (decl, why)
+                frontier.append(decl)
+        while frontier:
+            mod = frontier.pop(0)
+            decl, why = root_of[mod]
+            for site in graph.external_runtime_imports(mod, set(forbidden)):
+                chain_parts = []
+                cur: str | None = mod
+                while cur is not None:
+                    chain_parts.append(cur)
+                    cur = parent[cur]
+                chain = " <- ".join(chain_parts)
+                src = graph.by_module[mod]
+                findings.append(
+                    Finding(
+                        src.rel,
+                        site.line,
+                        "import-boundary",
+                        f"module-level import of {site.target!r} breaks the "
+                        f"declared {'/'.join(sorted(forbidden))}-free "
+                        f"contract of {decl!r} ({why}); chain: {chain}. "
+                        "Lazy (function-level) or try/except-ImportError "
+                        "imports are the sanctioned escape hatch",
+                    )
+                )
+            for dep in sorted(graph._internal_deps(mod, runtime_only=True)):
+                if dep not in parent:
+                    parent[dep] = mod
+                    root_of[dep] = (decl, why)
+                    frontier.append(dep)
+    return sorted(set(findings))

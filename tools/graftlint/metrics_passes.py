@@ -1,0 +1,352 @@
+"""The six folded legacy lints (formerly tools/lint_metrics.py), as
+graftlint passes plus the plain-string functions the back-compat shim
+re-exports.
+
+Pass ids and what they pin (full rationale in each function docstring):
+
+  * `namespace`  — string-literal metric/trace/source-class names vs the
+    canonical schemas (regex scan, like the original).
+  * `scheduler`  — every registered source class has its queue-delay
+    histogram row and DRAINS in the dispatch simulation.
+  * `telemetry`  — every evaluated SLOSpec binds to a registered
+    histogram row; every source class has an evaluated SLO.
+  * `pipeline`   — DispatchPipeline timeline stages ⊆ DeviceTimeline
+    phases.
+  * `scenarios`  — every chaos scenario carries an expectation and is
+    runnable by some test tier.
+  * `matrix`     — every matrix-grid scenario resolves and is
+    committee-size-invariant.
+
+The import-based lints run only when the scan root IS the repo (it
+contains `hotstuff_tpu/`); on fixture roots they no-op, so per-pass
+fixture tests stay hermetic. They import `hotstuff_tpu` from sys.path —
+jax-free by the import-boundary pass's own contract.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from .core import Context, Finding, Source, register
+
+_METRIC_CALL = re.compile(
+    r"""(?:metrics\s*\.\s*|\br\s*\.\s*|^\s*)              # metrics. / r. / bare
+        (counter|gauge|histogram)\s*\(\s*["']([^"']+)["']""",
+    re.VERBOSE | re.MULTILINE,
+)
+# f-strings are skipped (a dynamic kind is the caller's responsibility
+# to keep inside the canonical vocabulary, e.g. the watchdog's
+# `watchdog.<reason>` family).
+_TRACE_CALL = re.compile(
+    r"""(?:tracing\s*\.\s*event|\bevent|RECORDER\s*\.\s*record|\br\s*\.\s*record|self\s*\.\s*record)
+        \s*\(\s*\n?\s*(?<![fF])["']([^"'{}]+)["']""",
+    re.VERBOSE,
+)
+# Declared scheduler source classes at verification call sites
+# (`verify_group(..., source="…")` / `verify(..., source="…")`).
+_SOURCE_KWARG = re.compile(r"""\bsource\s*=\s*["']([^"'{}]+)["']""")
+
+
+def _lineno(text: str, pos: int) -> int:
+    return text.count("\n", 0, pos) + 1
+
+
+def scan_file(
+    path: str, metric_names: set, trace_kinds: set, source_classes: set
+) -> list[str]:
+    """Legacy string-form scan of one file (the shim's public surface)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    return [
+        msg
+        for _line, msg in _scan_text(
+            path, text, metric_names, trace_kinds, source_classes
+        )
+    ]
+
+
+def _scan_text(
+    label: str,
+    text: str,
+    metric_names: set,
+    trace_kinds: set,
+    source_classes: set,
+) -> list[tuple[int, str]]:
+    problems: list[tuple[int, str]] = []
+    for m in _METRIC_CALL.finditer(text):
+        kind, name = m.group(1), m.group(2)
+        if name not in metric_names:
+            problems.append(
+                (
+                    _lineno(text, m.start()),
+                    f"{label}: {kind}({name!r}) not in "
+                    "metrics._DEFAULT_NAMESPACE",
+                )
+            )
+    for m in _TRACE_CALL.finditer(text):
+        kind = m.group(1)
+        if kind and kind not in trace_kinds:
+            problems.append(
+                (
+                    _lineno(text, m.start()),
+                    f"{label}: trace event {kind!r} not in "
+                    "tracing.EVENT_KINDS",
+                )
+            )
+    for m in _SOURCE_KWARG.finditer(text):
+        name = m.group(1)
+        if name not in source_classes:
+            problems.append(
+                (
+                    _lineno(text, m.start()),
+                    f"{label}: source={name!r} not in "
+                    "scheduler.SOURCE_CLASSES",
+                )
+            )
+    return problems
+
+
+def lint_scheduler() -> list[str]:
+    """The starvation lint: every registered source class must (a) own a
+    queue-delay histogram row in the canonical namespace and (b) drain in
+    the scheduler's selection logic (one pending group per class, no
+    further arrivals, simulated clock — `drain_order()` replays the real
+    form_bucket/drain_critical code paths)."""
+    from hotstuff_tpu.crypto import scheduler
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+
+    problems: list[str] = []
+    metric_names = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
+    for name in sorted(scheduler.SOURCE_CLASSES):
+        row = f"scheduler.queue_{name}_s"
+        if row not in metric_names:
+            problems.append(
+                f"scheduler source class {name!r} has no {row!r} histogram "
+                "in metrics._DEFAULT_NAMESPACE (its queueing delay would "
+                "be invisible)"
+            )
+    drained = set(scheduler.drain_order())
+    for name in sorted(set(scheduler.SOURCE_CLASSES) - drained):
+        problems.append(
+            f"scheduler source class {name!r} can be enqueued but is never "
+            "selected by the dispatch loop (starvation — see "
+            "scheduler.drain_order())"
+        )
+    return problems
+
+
+def lint_telemetry() -> list[str]:
+    """Every evaluated SLOSpec must bind to a registered metric row, and
+    every registered source class must have an SLO the telemetry plane
+    evaluates (default_slos is the evaluated set of record)."""
+    from hotstuff_tpu.crypto import scheduler
+    from hotstuff_tpu.utils import telemetry
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+
+    problems: list[str] = []
+    metric_kinds = {name: kind for name, kind, _b in _DEFAULT_NAMESPACE}
+    specs = telemetry.default_slos()
+    for spec in specs:
+        kind = metric_kinds.get(spec.metric)
+        if kind is None:
+            problems.append(
+                f"SLOSpec {spec.name!r} references metric {spec.metric!r} "
+                "missing from metrics._DEFAULT_NAMESPACE (the burn "
+                "evaluator would see zero events forever)"
+            )
+        elif kind != "histogram":
+            problems.append(
+                f"SLOSpec {spec.name!r} binds to {spec.metric!r}, a "
+                f"{kind} row — the burn evaluator reads bucketed "
+                "histograms only, so this SLO would silently never see "
+                "an event"
+            )
+        if spec.lane is not None and spec.lane not in scheduler.SOURCE_CLASSES:
+            problems.append(
+                f"SLOSpec {spec.name!r} targets unregistered lane "
+                f"{spec.lane!r}"
+            )
+    covered = {spec.lane for spec in specs if spec.lane is not None}
+    for name in sorted(set(scheduler.SOURCE_CLASSES) - covered):
+        problems.append(
+            f"scheduler source class {name!r} has no SLO in "
+            "telemetry.default_slos() — its slo_s is back to an advisory "
+            "string nothing evaluates"
+        )
+    return problems
+
+
+def lint_pipeline() -> list[str]:
+    """Every DeviceTimeline stage a DispatchPipeline run can stamp must
+    be a known timeline phase: the occupancy/headroom summary and the
+    trace_report device rows key on the PHASES vocabulary, so an unknown
+    stage records intervals nothing ever reads."""
+    from hotstuff_tpu.ops import pipeline, timeline
+
+    return [
+        f"DispatchPipeline timeline stage {name!r} is not one of "
+        f"DeviceTimeline's phases {sorted(timeline.PHASES)} — it would "
+        "fall out of the occupancy/headroom math and the trace_report "
+        "device rows"
+        for name in pipeline.TIMELINE_STAGES
+        if name not in timeline.PHASES
+    ]
+
+
+def lint_scenarios(tests_dir: str | None = None) -> list[str]:
+    """Every chaos scenario must carry an expectation and be runnable by
+    some test tier (see module docstring). Imports jax-free — the chaos
+    plane runs on pysigner by design."""
+    from hotstuff_tpu.chaos.scenarios import SCENARIOS, SHORT_SCENARIOS
+
+    if tests_dir is None:
+        tests_dir = os.path.join(
+            os.path.dirname(__file__), "..", "..", "tests"
+        )
+    corpus = ""
+    if os.path.isdir(tests_dir):
+        for fn in sorted(os.listdir(tests_dir)):
+            if fn.endswith(".py"):
+                with open(os.path.join(tests_dir, fn), encoding="utf-8") as f:
+                    corpus += f.read()
+    problems: list[str] = []
+    for name, scenario in sorted(SCENARIOS.items()):
+        if scenario.expect is None:
+            problems.append(
+                f"chaos scenario {name!r} has no expectation — it would "
+                "pass even when the fault it models stops firing; add an "
+                "expect="
+            )
+        quoted = f'"{name}"' in corpus or f"'{name}'" in corpus
+        if name not in SHORT_SCENARIOS and not quoted:
+            problems.append(
+                f"chaos scenario {name!r} is outside the tier-1 sweep "
+                "(slow) and named in no tests/ module — nothing ever "
+                "runs it"
+            )
+    return problems
+
+
+def lint_matrix() -> list[str]:
+    """Every matrix-grid scenario must resolve in the registry and be
+    committee-size-invariant (no pinned committee subset) — the grid is
+    the regression harness for every scale claim, so a silently-dropped
+    cell is a silently-dropped guarantee."""
+    from hotstuff_tpu.chaos.scenarios import MATRIX_SCENARIOS, SCENARIOS
+
+    problems: list[str] = []
+    for name in MATRIX_SCENARIOS:
+        scenario = SCENARIOS.get(name)
+        if scenario is None:
+            problems.append(
+                f"matrix-grid scenario {name!r} does not resolve in the "
+                "chaos scenario registry (chaos_run.py --matrix would "
+                "reject the default grid)"
+            )
+        elif scenario.committee is not None:
+            problems.append(
+                f"matrix-grid scenario {name!r} pins committee indices "
+                f"{scenario.committee} — grid cells override the "
+                "committee size, which a pinned subset cannot survive"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# graftlint pass wrappers
+
+
+def _is_repo_root(ctx: Context) -> bool:
+    return any(s.rel == "hotstuff_tpu/__init__.py" for s in ctx.sources)
+
+
+def _anchor(ctx: Context, rel: str) -> str:
+    return rel if any(s.rel == rel for s in ctx.sources) else "hotstuff_tpu"
+
+
+def _wrap(ctx: Context, pass_id: str, rel: str, problems: list[str]):
+    anchor = _anchor(ctx, rel)
+    return [Finding(anchor, 1, pass_id, msg) for msg in problems]
+
+
+@register(
+    "namespace",
+    "string-literal metric/trace/source-class names vs the canonical schemas",
+)
+def run_namespace(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    from hotstuff_tpu.crypto.scheduler import SOURCE_CLASSES
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+    from hotstuff_tpu.utils.tracing import EVENT_KINDS
+
+    metric_names = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
+    findings: list[Finding] = []
+    for src in ctx.sources_under("hotstuff_tpu/"):
+        for line, msg in _scan_text(
+            src.rel,
+            src.text,
+            metric_names,
+            set(EVENT_KINDS),
+            set(SOURCE_CLASSES),
+        ):
+            # strip the legacy "<path>: " prefix; Finding carries the path
+            findings.append(
+                Finding(
+                    src.rel,
+                    line,
+                    "namespace",
+                    msg[len(src.rel) + 2 :] if msg.startswith(src.rel) else msg,
+                )
+            )
+    return findings
+
+
+@register("scheduler", "source-class histogram rows + drain (starvation) sim")
+def run_scheduler(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    return _wrap(
+        ctx, "scheduler", "hotstuff_tpu/crypto/scheduler.py", lint_scheduler()
+    )
+
+
+@register("telemetry", "SLOSpec bindings and per-lane SLO coverage")
+def run_telemetry(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    return _wrap(
+        ctx, "telemetry", "hotstuff_tpu/utils/telemetry.py", lint_telemetry()
+    )
+
+
+@register("pipeline", "DispatchPipeline stages ⊆ DeviceTimeline phases")
+def run_pipeline(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    return _wrap(
+        ctx, "pipeline", "hotstuff_tpu/ops/pipeline.py", lint_pipeline()
+    )
+
+
+@register("scenarios", "chaos scenarios carry expectations and a test tier")
+def run_scenarios(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    tests_dir = os.path.join(ctx.root, "tests")
+    return _wrap(
+        ctx,
+        "scenarios",
+        "hotstuff_tpu/chaos/scenarios.py",
+        lint_scenarios(tests_dir if os.path.isdir(tests_dir) else None),
+    )
+
+
+@register("matrix", "matrix-grid scenarios resolve and are size-invariant")
+def run_matrix(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    return _wrap(
+        ctx, "matrix", "hotstuff_tpu/chaos/scenarios.py", lint_matrix()
+    )

@@ -1,0 +1,177 @@
+"""task-hygiene pass: every task must have an owner, every wait a loop.
+
+The repo's crash model depends on it: `utils/actors.spawn` adopts each
+task into the ambient `SpawnScope` (a contextvar that propagates to
+transitively spawned tasks), so a chaos crash is ONE scope-cancel of the
+node's whole task tree. A task created behind the scope's back survives
+the "crash" and keeps touching sockets/stores the next incarnation owns
+— the exact bug class scope adoption exists to kill. Flagged:
+
+  * bare `create_task` / `ensure_future` calls outside
+    `utils/actors.py` (the one sanctioned wrapper site). Genuine
+    exceptions — e.g. `chaos/vtime.py`'s loop bootstrap, which runs
+    BEFORE any loop exists for spawn() to query — carry a pragma naming
+    the lifecycle owner.
+  * `time.sleep(...)` inside `async def` — blocks the event loop (and
+    the virtual-time loop cannot advance through it); use
+    `asyncio.sleep`.
+  * un-awaited coroutine calls: a bare `f()` expression statement where
+    `f` is an `async def` in the same module — the coroutine is created
+    and garbage-collected without ever running (asyncio warns at GC
+    time, long after the bug).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Context, Finding, Source, register
+
+_SPAWN_SITES = {"create_task", "ensure_future"}
+
+
+def _async_def_names(tree: ast.Module) -> tuple[set[str], set[str]]:
+    """(module-level async function names, async method names) — a method
+    name is only returned when EVERY def of that name in the file is
+    async, so a sync/async name collision never false-positives."""
+    top: set[str] = set()
+    method_async: dict[str, bool] = {}
+    for node in tree.body:
+        if isinstance(node, ast.AsyncFunctionDef):
+            top.add(node.name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.AsyncFunctionDef):
+                    method_async.setdefault(item.name, True)
+                elif isinstance(item, ast.FunctionDef):
+                    method_async[item.name] = False
+    methods = {name for name, ok in method_async.items() if ok}
+    return top, methods
+
+
+def _from_imports(tree: ast.Module, target: str) -> dict[str, str]:
+    """local name -> original name for `from target import x [as y]` —
+    the attribute-call checks alone would miss the from-import form
+    (`from asyncio import ensure_future; ensure_future(...)`)."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.ImportFrom)
+            and node.level == 0
+            and node.module == target
+        ):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _check_source(src: Source, findings: list[Finding]) -> None:
+    tree = src.tree
+    assert tree is not None
+    is_actors = src.rel.endswith("utils/actors.py")
+    top_async, method_async = _async_def_names(tree)
+    aio_from = _from_imports(tree, "asyncio")
+    time_from = _from_imports(tree, "time")
+
+    def flag(node: ast.AST, message: str) -> None:
+        findings.append(
+            Finding(
+                src.rel, getattr(node, "lineno", 1), "task-hygiene", message
+            )
+        )
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.async_depth = 0
+
+        def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+            self.async_depth += 1
+            self.generic_visit(node)
+            self.async_depth -= 1
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            depth, self.async_depth = self.async_depth, 0
+            self.generic_visit(node)
+            self.async_depth = depth
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+                if aio_from.get(name) in _SPAWN_SITES and not is_actors:
+                    flag(
+                        node,
+                        f"bare `{name}` (from-imported asyncio."
+                        f"{aio_from[name]}) outside utils/actors.py — the "
+                        "task escapes SpawnScope adoption; use "
+                        "`actors.spawn` (or pragma with the lifecycle "
+                        "owner named)",
+                    )
+                elif time_from.get(name) == "sleep" and self.async_depth > 0:
+                    flag(
+                        node,
+                        f"`{name}()` (from-imported time.sleep) inside "
+                        "`async def` blocks the event loop; use "
+                        "`await asyncio.sleep(...)`",
+                    )
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in _SPAWN_SITES and not is_actors:
+                    flag(
+                        node,
+                        f"bare `{attr}` outside utils/actors.py — the task "
+                        "escapes SpawnScope adoption, so a chaos "
+                        "crash-cancel misses it; use `actors.spawn` (or "
+                        "pragma with the lifecycle owner named)",
+                    )
+                if (
+                    attr == "sleep"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "time"
+                    and self.async_depth > 0
+                ):
+                    flag(
+                        node,
+                        "`time.sleep` inside `async def` blocks the event "
+                        "loop (and freezes the virtual-time loop); use "
+                        "`await asyncio.sleep(...)`",
+                    )
+            self.generic_visit(node)
+
+        def visit_Expr(self, node: ast.Expr) -> None:
+            call = node.value
+            if isinstance(call, ast.Call):
+                name = None
+                if isinstance(call.func, ast.Name):
+                    if call.func.id in top_async:
+                        name = call.func.id
+                elif (
+                    isinstance(call.func, ast.Attribute)
+                    and isinstance(call.func.value, ast.Name)
+                    and call.func.value.id == "self"
+                    and call.func.attr in method_async
+                ):
+                    name = f"self.{call.func.attr}"
+                if name is not None:
+                    flag(
+                        node,
+                        f"`{name}(...)` is an async def called without "
+                        "await/spawn — the coroutine object is created and "
+                        "silently never runs",
+                    )
+            self.generic_visit(node)
+
+    Visitor().visit(tree)
+
+
+@register(
+    "task-hygiene",
+    "bare task spawns, blocking sleeps in async code, un-awaited coroutines",
+)
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.sources_under("hotstuff_tpu/", "tools/", "benchmark/"):
+        if src.tree is None:
+            continue
+        _check_source(src, findings)
+    return findings

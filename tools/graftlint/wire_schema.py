@@ -1,0 +1,195 @@
+"""wire-schema pass: message-tag uniqueness and domain-separation
+uniqueness, checked where the codecs are WRITTEN.
+
+Two invariants nothing at runtime re-checks:
+
+  * Frame tags. Every framed wire codec module declares its tag space as
+    module-level `TAG_<NAME> = <int>` constants next to its
+    encode/decode pair (consensus/messages.py, mempool/messages.py).
+    Two tags sharing a value silently decode one message kind as the
+    other — within a module (one codec = one tag namespace), values
+    must be unique.
+
+  * Digest domains. Every signed artifact commits to a domain-separated
+    digest whose preimage STARTS with a distinguishing prefix
+    (b"HSVOTE", b"HSBLOCK", ...; ingress declares TX_DOMAIN, the
+    trusted-crypto stub declares DOMAIN). Two artifacts claiming the
+    same leading prefix — or one prefix being a proper prefix of
+    another — collapse their preimage spaces: a signature over one
+    artifact kind becomes valid for a forgeable cousin. Claims are
+    collected syntactically at preimage-construction sites:
+
+      - module-level `<NAME>DOMAIN... = b"..."` constants;
+      - a `b"HS..."` literal as the leftmost term of the expression
+        assigned to a name (`h = b"HSBLOCK" + ...`) or passed to a
+        digest entrypoint (`sha512_32(b"HSVOTE" + ...)`,
+        `hashlib.sha512(...)`);
+      - a bare `b"HS..."` literal as the sole argument of an
+        `.update(...)` call (the incremental-hash first block).
+
+    Appending a tagged section INSIDE an existing preimage
+    (`h += b"HSEPOCH" + ...`) is not a claim — interior markers share
+    the enclosing domain on purpose.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Context, Finding, Source, register
+
+_TAG_NAME = re.compile(r"^TAG_[A-Z0-9_]+$")
+_DOMAIN_LITERAL = re.compile(rb"^HS[A-Z0-9]+$")
+_DOMAIN_CONST = re.compile(r"DOMAIN")
+_DIGEST_FNS = {"sha512_32", "sha512", "sha256", "blake2b"}
+
+
+def _leftmost(expr: ast.expr) -> ast.expr:
+    while isinstance(expr, ast.BinOp):
+        expr = expr.left
+    return expr
+
+
+def _domain_bytes(expr: ast.expr) -> bytes | None:
+    node = _leftmost(expr)
+    if isinstance(node, ast.Constant) and isinstance(node.value, bytes):
+        if _DOMAIN_LITERAL.match(node.value):
+            return node.value
+    return None
+
+
+def _collect_claims(
+    src: Source, claims: list[tuple[bytes, str, int, str]]
+) -> None:
+    """Append (domain, path, line, site-kind) claims from one file."""
+    tree = src.tree
+    assert tree is not None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            # module/function constant: NAME_DOMAIN = b"..."
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and _DOMAIN_CONST.search(tgt.id)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, bytes)
+                ):
+                    claims.append(
+                        (node.value.value, src.rel, node.lineno, tgt.id)
+                    )
+            dom = _domain_bytes(node.value)
+            if dom is not None:
+                claims.append((dom, src.rel, node.lineno, "preimage head"))
+        elif isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else None
+            )
+            if name in _DIGEST_FNS and node.args:
+                dom = _domain_bytes(node.args[0])
+                if dom is not None:
+                    claims.append(
+                        (dom, src.rel, node.lineno, f"{name}() preimage")
+                    )
+            elif (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "update"
+                and len(node.args) == 1
+            ):
+                dom = _domain_bytes(node.args[0])
+                if dom is not None:
+                    claims.append(
+                        (dom, src.rel, node.lineno, "hash first update")
+                    )
+
+
+def _check_tags(src: Source, findings: list[Finding]) -> None:
+    tree = src.tree
+    assert tree is not None
+    seen: dict[int, tuple[str, int]] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Name)
+                and _TAG_NAME.match(tgt.id)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)
+            ):
+                value = node.value.value
+                prev = seen.get(value)
+                if prev is not None and prev[0] != tgt.id:
+                    findings.append(
+                        Finding(
+                            src.rel,
+                            node.lineno,
+                            "wire-schema",
+                            f"frame tag {tgt.id} = {value} collides with "
+                            f"{prev[0]} (line {prev[1]}) in the same codec "
+                            "module — one message kind would decode as the "
+                            "other",
+                        )
+                    )
+                else:
+                    seen.setdefault(value, (tgt.id, node.lineno))
+
+
+@register(
+    "wire-schema",
+    "frame-tag uniqueness per codec module, digest-domain uniqueness repo-wide",
+)
+def run(ctx: Context) -> list[Finding]:
+    findings: list[Finding] = []
+    claims: list[tuple[bytes, str, int, str]] = []
+    for src in ctx.sources_under("hotstuff_tpu/"):
+        if src.tree is None:
+            continue
+        _check_tags(src, findings)
+        _collect_claims(src, claims)
+    # Cross-module duplicate claims: the same leading prefix declared in
+    # two files is two artifact kinds sharing a preimage space. Repeats
+    # WITHIN a file are fine (a codec recomputes its own domain freely).
+    by_domain: dict[bytes, dict[str, tuple[int, str]]] = {}
+    for dom, path, line, kind in sorted(
+        claims, key=lambda c: (c[0], c[1], c[2])
+    ):
+        files = by_domain.setdefault(dom, {})
+        if path not in files:
+            files[path] = (line, kind)
+    for dom, files in sorted(by_domain.items()):
+        if len(files) > 1:
+            where = ", ".join(
+                f"{p}:{line} ({kind})" for p, (line, kind) in sorted(files.items())
+            )
+            for path, (line, kind) in sorted(files.items()):
+                findings.append(
+                    Finding(
+                        path,
+                        line,
+                        "wire-schema",
+                        f"digest domain {dom!r} is claimed by more than one "
+                        f"module ({where}) — distinct artifacts must not "
+                        "share a preimage prefix",
+                    )
+                )
+    # Prefix shadowing: domain A being a proper prefix of domain B makes
+    # an A-preimage forgeable as a B-preimage head.
+    domains = sorted(by_domain)
+    for i, a in enumerate(domains):
+        for b in domains[i + 1 :]:
+            if b.startswith(a) and a != b:
+                pa = sorted(by_domain[a].items())[0]
+                pb = sorted(by_domain[b].items())[0]
+                findings.append(
+                    Finding(
+                        pa[0],
+                        pa[1][0],
+                        "wire-schema",
+                        f"digest domain {a!r} is a proper prefix of {b!r} "
+                        f"(declared at {pb[0]}:{pb[1][0]}) — domain "
+                        "separation requires prefix-free codes",
+                    )
+                )
+    return findings

@@ -1,270 +1,46 @@
 #!/usr/bin/env python3
-"""Metric / trace namespace lint + scheduler starvation lint.
+"""Back-compat shim over tools/graftlint (the repo's ONE static-analysis
+entrypoint — `python -m tools.graftlint`).
 
     python tools/lint_metrics.py            # scan hotstuff_tpu/
     python tools/lint_metrics.py --root DIR # scan an arbitrary tree
 
-Scans every Python file for string-literal metric registrations
-(`metrics.counter("…")` / `gauge` / `histogram`, and the module-local
-`counter("…")` forms) and flight-recorder stamps (`tracing.event("…")` /
-`RECORDER.record("…")`), and fails (rc 1) if any name is missing from
-the canonical schema:
+The six lints that used to live here — the metric/trace/source-class
+namespace scan, the scheduler starvation lint, the telemetry SLO lint,
+the pipeline timeline-stage lint, the chaos scenario-registry lint, and
+the matrix-grid lint — are now graftlint passes (`namespace`,
+`scheduler`, `telemetry`, `pipeline`, `scenarios`, `matrix`;
+tools/graftlint/metrics_passes.py carries the full rationale for each).
+This shim pins the original CLI contract for callers and CI recipes
+that predate the fold:
 
-  * metrics  -> `hotstuff_tpu.utils.metrics._DEFAULT_NAMESPACE`
-  * tracing  -> `hotstuff_tpu.utils.tracing.EVENT_KINDS`
+  * same flags (`--root`, default hotstuff_tpu/),
+  * same stderr problem lines and stdout "clean" line,
+  * same exit codes: 0 = clean, 1 = violations found, 2 = usage error,
+  * same importable functions (`scan_file`, `lint_scheduler`,
+    `lint_telemetry`, `lint_pipeline`, `lint_scenarios`, `lint_matrix`,
+    `run`) — tests/test_harness.py drives them directly.
 
-This keeps `metrics.dump()`'s full-schema guarantee honest as layers
-grow (a dump must carry EVERY name, zeros included — a name registered
-only at a call site would appear in some processes and not others), and
-keeps the trace-stage vocabulary stable for `tools/trace_report.py`.
-
-The scheduler lint (crypto/scheduler.py) additionally fails rc 1 when
-(the `aggregate` bundle-verification class from consensus/overlay.py is
-covered like any other registered class — queue row, SLO, drain order):
-
-  * a `source="…"` literal at any `verify_group`/`verify` call site
-    names a class missing from `scheduler.SOURCE_CLASSES` (it would
-    raise at runtime — callers must register, not invent);
-  * a registered class has no `scheduler.queue_<name>_s` row in the
-    canonical namespace (its queueing delay would be invisible); or
-  * a registered class does not DRAIN: the selection logic is simulated
-    over one pending group per class with no further arrivals
-    (`scheduler.drain_order()`), and any class never selected could be
-    enqueued but starve forever.
-
-The telemetry lint (utils/telemetry.py) fails rc 1 when:
-
-  * an evaluated `SLOSpec` references a metric missing from the
-    canonical namespace (the burn evaluator would silently see zero
-    events forever); or
-  * a registered scheduler source class has NO SLO in the evaluated set
-    (`telemetry.default_slos()`) — its published slo_s would be back to
-    an advisory string nothing judges.
-
-The pipeline lint (ops/pipeline.py) fails rc 1 when a DispatchPipeline
-timeline stage name (`pipeline.TIMELINE_STAGES`) is not one of
-DeviceTimeline's known phases (`timeline.PHASES`) — a renamed stage
-would silently fall out of the occupancy/headroom math and out of
-trace_report.py's device rows.
-
-The scenario-registry lint (chaos/scenarios.py) fails rc 1 when:
-
-  * a registered chaos scenario has no `expect` — every scenario must
-    assert something beyond not-crashing, or it degenerates into a
-    smoke test that passes while the fault it models stops firing; or
-  * a scenario appears in NO test matrix: non-slow scenarios are swept
-    by tests/test_chaos.py's SHORT_SCENARIOS parametrization by
-    construction, but a `slow=True` scenario must be named (string
-    literal) somewhere under tests/ or nothing ever runs it.
-
-The matrix-grid lint (chaos/scenarios.py MATRIX_SCENARIOS) fails rc 1
-when a grid scenario name does not resolve in the scenario registry
-(the matrix runner would rc-3 at sweep time, long after the rename that
-broke it), or when a grid scenario pins `committee=` indices — grid
-cells override the committee size, which a pinned subset cannot survive
-(run_scenario refuses the override at runtime; the lint catches it at
-review time).
-
-`utils/telemetry.py`, `ops/timeline.py` and `ops/pipeline.py` must stay
-importable without jax (like DeviceScheduler) — this lint runs on
-jax-less hosts.
-
-Exit codes: 0 = clean, 1 = violations found, 2 = usage error.
+NOTE: unlike `python -m tools.graftlint`, this surface applies no
+pragmas and no baseline — it is exactly the pre-fold behavior.
 """
 
 from __future__ import annotations
 
 import argparse
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-_METRIC_CALL = re.compile(
-    r"""(?:metrics\s*\.\s*|\br\s*\.\s*|^\s*)              # metrics. / r. / bare
-        (counter|gauge|histogram)\s*\(\s*["']([^"']+)["']""",
-    re.VERBOSE | re.MULTILINE,
+from tools.graftlint.metrics_passes import (  # noqa: E402,F401
+    lint_matrix,
+    lint_pipeline,
+    lint_scenarios,
+    lint_scheduler,
+    lint_telemetry,
+    scan_file,
 )
-# f-strings are skipped (a dynamic kind is the caller's responsibility
-# to keep inside the canonical vocabulary, e.g. the watchdog's
-# `watchdog.<reason>` family).
-_TRACE_CALL = re.compile(
-    r"""(?:tracing\s*\.\s*event|\bevent|RECORDER\s*\.\s*record|\br\s*\.\s*record|self\s*\.\s*record)
-        \s*\(\s*\n?\s*(?<![fF])["']([^"'{}]+)["']""",
-    re.VERBOSE,
-)
-# Declared scheduler source classes at verification call sites
-# (`verify_group(..., source="…")` / `verify(..., source="…")`).
-_SOURCE_KWARG = re.compile(r"""\bsource\s*=\s*["']([^"'{}]+)["']""")
-
-
-def scan_file(
-    path: str, metric_names: set, trace_kinds: set, source_classes: set
-) -> list[str]:
-    with open(path, encoding="utf-8") as f:
-        text = f.read()
-    problems = []
-    for kind, name in _METRIC_CALL.findall(text):
-        if name not in metric_names:
-            problems.append(
-                f"{path}: {kind}({name!r}) not in metrics._DEFAULT_NAMESPACE"
-            )
-    for kind in _TRACE_CALL.findall(text):
-        if kind and kind not in trace_kinds:
-            problems.append(
-                f"{path}: trace event {kind!r} not in tracing.EVENT_KINDS"
-            )
-    for name in _SOURCE_KWARG.findall(text):
-        if name not in source_classes:
-            problems.append(
-                f"{path}: source={name!r} not in scheduler.SOURCE_CLASSES"
-            )
-    return problems
-
-
-def lint_scheduler() -> list[str]:
-    """The starvation lint: every registered source class must (a) own a
-    queue-delay histogram row in the canonical namespace and (b) drain in
-    the scheduler's selection logic (one pending group per class, no
-    further arrivals, simulated clock — `drain_order()` replays the real
-    form_bucket/drain_critical code paths)."""
-    from hotstuff_tpu.crypto import scheduler
-    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
-
-    problems: list[str] = []
-    metric_names = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
-    for name in sorted(scheduler.SOURCE_CLASSES):
-        row = f"scheduler.queue_{name}_s"
-        if row not in metric_names:
-            problems.append(
-                f"scheduler source class {name!r} has no {row!r} histogram "
-                "in metrics._DEFAULT_NAMESPACE (its queueing delay would "
-                "be invisible)"
-            )
-    drained = set(scheduler.drain_order())
-    for name in sorted(set(scheduler.SOURCE_CLASSES) - drained):
-        problems.append(
-            f"scheduler source class {name!r} can be enqueued but is never "
-            "selected by the dispatch loop (starvation — see "
-            "scheduler.drain_order())"
-        )
-    return problems
-
-
-def lint_telemetry() -> list[str]:
-    """Every evaluated SLOSpec must bind to a registered metric row, and
-    every registered source class must have an SLO the telemetry plane
-    evaluates (default_slos is the evaluated set of record)."""
-    from hotstuff_tpu.crypto import scheduler
-    from hotstuff_tpu.utils import telemetry
-    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
-
-    problems: list[str] = []
-    metric_kinds = {name: kind for name, kind, _b in _DEFAULT_NAMESPACE}
-    specs = telemetry.default_slos()
-    for spec in specs:
-        kind = metric_kinds.get(spec.metric)
-        if kind is None:
-            problems.append(
-                f"SLOSpec {spec.name!r} references metric {spec.metric!r} "
-                "missing from metrics._DEFAULT_NAMESPACE (the burn "
-                "evaluator would see zero events forever)"
-            )
-        elif kind != "histogram":
-            problems.append(
-                f"SLOSpec {spec.name!r} binds to {spec.metric!r}, a "
-                f"{kind} row — the burn evaluator reads bucketed "
-                "histograms only, so this SLO would silently never see "
-                "an event"
-            )
-        if spec.lane is not None and spec.lane not in scheduler.SOURCE_CLASSES:
-            problems.append(
-                f"SLOSpec {spec.name!r} targets unregistered lane "
-                f"{spec.lane!r}"
-            )
-    covered = {spec.lane for spec in specs if spec.lane is not None}
-    for name in sorted(set(scheduler.SOURCE_CLASSES) - covered):
-        problems.append(
-            f"scheduler source class {name!r} has no SLO in "
-            "telemetry.default_slos() — its slo_s is back to an advisory "
-            "string nothing evaluates"
-        )
-    return problems
-
-
-def lint_pipeline() -> list[str]:
-    """Every DeviceTimeline stage a DispatchPipeline run can stamp must
-    be a known timeline phase: the occupancy/headroom summary and the
-    trace_report device rows key on the PHASES vocabulary, so an unknown
-    stage records intervals nothing ever reads."""
-    from hotstuff_tpu.ops import pipeline, timeline
-
-    return [
-        f"DispatchPipeline timeline stage {name!r} is not one of "
-        f"DeviceTimeline's phases {sorted(timeline.PHASES)} — it would "
-        "fall out of the occupancy/headroom math and the trace_report "
-        "device rows"
-        for name in pipeline.TIMELINE_STAGES
-        if name not in timeline.PHASES
-    ]
-
-
-def lint_scenarios(tests_dir: str | None = None) -> list[str]:
-    """Every chaos scenario must carry an expectation and be runnable by
-    some test tier (see module docstring). Imports jax-free — the chaos
-    plane runs on pysigner by design."""
-    from hotstuff_tpu.chaos.scenarios import SCENARIOS, SHORT_SCENARIOS
-
-    if tests_dir is None:
-        tests_dir = os.path.join(os.path.dirname(__file__), "..", "tests")
-    corpus = ""
-    if os.path.isdir(tests_dir):
-        for fn in sorted(os.listdir(tests_dir)):
-            if fn.endswith(".py"):
-                with open(os.path.join(tests_dir, fn), encoding="utf-8") as f:
-                    corpus += f.read()
-    problems: list[str] = []
-    for name, scenario in sorted(SCENARIOS.items()):
-        if scenario.expect is None:
-            problems.append(
-                f"chaos scenario {name!r} has no expectation — it would "
-                "pass even when the fault it models stops firing; add an "
-                "expect="
-            )
-        quoted = f'"{name}"' in corpus or f"'{name}'" in corpus
-        if name not in SHORT_SCENARIOS and not quoted:
-            problems.append(
-                f"chaos scenario {name!r} is outside the tier-1 sweep "
-                "(slow) and named in no tests/ module — nothing ever "
-                "runs it"
-            )
-    return problems
-
-
-def lint_matrix() -> list[str]:
-    """Every matrix-grid scenario must resolve in the registry and be
-    committee-size-invariant (no pinned committee subset) — the grid is
-    the regression harness for every scale claim, so a silently-dropped
-    cell is a silently-dropped guarantee."""
-    from hotstuff_tpu.chaos.scenarios import MATRIX_SCENARIOS, SCENARIOS
-
-    problems: list[str] = []
-    for name in MATRIX_SCENARIOS:
-        scenario = SCENARIOS.get(name)
-        if scenario is None:
-            problems.append(
-                f"matrix-grid scenario {name!r} does not resolve in the "
-                "chaos scenario registry (chaos_run.py --matrix would "
-                "reject the default grid)"
-            )
-        elif scenario.committee is not None:
-            problems.append(
-                f"matrix-grid scenario {name!r} pins committee indices "
-                f"{scenario.committee} — grid cells override the "
-                "committee size, which a pinned subset cannot survive"
-            )
-    return problems
 
 
 def run(root: str) -> list[str]:
@@ -282,7 +58,7 @@ def run(root: str) -> list[str]:
             problems += scan_file(
                 os.path.join(dirpath, fn),
                 metric_names,
-                EVENT_KINDS,
+                set(EVENT_KINDS),
                 set(SOURCE_CLASSES),
             )
     return (

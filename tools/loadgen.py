@@ -104,7 +104,11 @@ def _run_selftest(args) -> dict:
             while True:
                 await sink.get()
 
-        drainer = asyncio.ensure_future(drain())
+        # actors.spawn, not bare ensure_future: same scope-adoption rule
+        # as every long-lived task (tools/graftlint task-hygiene pass).
+        from hotstuff_tpu.utils.actors import spawn
+
+        drainer = spawn(drain(), name="loadgen-selftest-drain")
         pipeline = IngressPipeline(
             service, sink, _selftest_config(args.capacity)
         )
