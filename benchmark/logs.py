@@ -160,6 +160,20 @@ def _parse_node(text: str) -> dict:
             r"Epoch switch to (\d+) at activation round (\d+)", text
         )
     ]
+    # Epoch-final handoff lines (consensus/reconfig.py §5.5j): one per
+    # committed rotation with the commit-to-boundary slack, plus the
+    # hard-invariant violation marker (which must normally never appear).
+    out["handoffs"] = [
+        (int(e), int(t_), int(b), int(s))
+        for e, t_, b, s in _search_all(
+            r"Epoch handoff to (\d+) committed at round (\d+) "
+            r"\(boundary (\d+), slack (\d+) rounds\)",
+            text,
+        )
+    ]
+    out["handoff_violations"] = len(
+        _search_all(r"Epoch handoff VIOLATION", text)
+    )
     out["range_lags"] = [
         int(lag)
         for lag in _search_all(
@@ -304,6 +318,11 @@ class LogParser:
         # (epoch, activation round) per switch line across nodes, and the
         # per-range-sync start lags / fetched-block totals (catch-up).
         self.epoch_switches: list[tuple[int, int]] = []
+        # (epoch, trigger round, boundary, slack) per committed handoff
+        # across nodes, and the count of handoff VIOLATION lines (the
+        # epoch-final hard invariant — must stay zero).
+        self.handoffs: list[tuple[int, int, int, int]] = []
+        self.handoff_violations = 0
         self.range_lags: list[int] = []
         self.range_blocks = 0
         # Aggregation-overlay scrapes: (kind, round, entries) per bundle
@@ -345,6 +364,8 @@ class LogParser:
             self.slo_fired.extend(r.get("slo_fired", []))
             self.slo_cleared.extend(r.get("slo_cleared", []))
             self.epoch_switches.extend(r.get("epoch_switches", []))
+            self.handoffs.extend(r.get("handoffs", []))
+            self.handoff_violations += r.get("handoff_violations", 0)
             self.range_lags.extend(r.get("range_lags", []))
             self.range_blocks += r.get("range_blocks", 0)
             self.agg_quorums.extend(r.get("agg_quorums", []))
@@ -599,13 +620,23 @@ class LogParser:
                     f" ({gossiped:,} entries gossiped over {frames:,} frames)\n"
                 )
         reconfig = ""
-        if self.epoch_switches or self.range_lags:
+        if self.epoch_switches or self.handoffs or self.range_lags:
             reconfig = " + RECONFIG:\n"
             if self.epoch_switches:
                 top_epoch, top_round = max(self.epoch_switches)
                 reconfig += (
                     f" Epoch switches observed: {len(self.epoch_switches)}"
                     f" (highest epoch {top_epoch} at round {top_round})\n"
+                )
+            if self.handoffs:
+                rotations = len({e for e, _t, _b, _s in self.handoffs})
+                # worst = SMALLEST slack: the handoff that came closest
+                # to its boundary (the margin-sizing signal).
+                worst_slack = min(s for _e, _t, _b, s in self.handoffs)
+                reconfig += (
+                    f" Handoffs: {len(self.handoffs)} across"
+                    f" {rotations} rotation(s), worst slack"
+                    f" {worst_slack} round(s) before the boundary\n"
                 )
             if self.range_lags:
                 reconfig += (
@@ -625,6 +656,13 @@ class LogParser:
                 f" WARNING: graftlint reported {self.graftlint_findings} "
                 "finding(s) — the deployed tree violates committed "
                 "contracts\n"
+            )
+        if self.handoff_violations:
+            warn += (
+                f" WARNING: {self.handoff_violations} epoch handoff "
+                "VIOLATION(s) — a commit landed at/past its declared "
+                "activation round (the epoch-final invariant; gap rounds "
+                "were certified by the old committee)\n"
             )
         if self.misses:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"

@@ -27,7 +27,12 @@ from .byzantine import (
     VoteWithholder,
 )
 from .invariants import LivenessChecker, SafetyChecker
-from .orchestrator import ChaosOrchestrator, DeterministicMempool, ReconfigDirective
+from .orchestrator import (
+    BoundaryCrash,
+    ChaosOrchestrator,
+    DeterministicMempool,
+    ReconfigDirective,
+)
 from .plan import (
     CrashWindow,
     DelayedBoot,
@@ -52,6 +57,7 @@ from .vtime import VirtualTimeLoop
 __all__ = [
     "AdversaryPolicy",
     "BundlePoisoner",
+    "BoundaryCrash",
     "ChaosOrchestrator",
     "CrashWindow",
     "DelayedBoot",

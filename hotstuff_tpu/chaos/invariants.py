@@ -22,6 +22,16 @@ Safety (2-chain HotStuff, consensus/src/messages.rs quorum rules):
                   epoch — on BOTH sides of a reconfiguration boundary. A
                   certificate quorate under the wrong epoch's committee
                   is a violation even if every signature is genuine.
+  * handoff     — the epoch-final contract, derived from chain content
+                  alone: for every committed EpochChange, the carrier's
+                  2-chain completion (a pair of consecutive-round
+                  committed blocks at/above the carrier) must sit
+                  strictly below the declared activation round. A chain
+                  violating this has gap rounds certified by the old
+                  committee — exactly what the certification wall
+                  (consensus/reconfig.py §5.5j) exists to forbid, so
+                  `reconfig.late_applies` is a violation here, not a
+                  warning.
 
 Liveness: commit height advances after a declared heal point (partitions
 healed, crashed nodes restarted) — evaluated per honest node.
@@ -48,6 +58,9 @@ class SafetyChecker:
         self._last: dict[int, object] = {}  # node -> last committed block
         self._verified_qcs: set[tuple[int, bytes]] = set()
         self.commits: dict[int, list[tuple[int, str]]] = {}  # node -> [(round, digest)]
+        # Epoch-final handoff audits: one entry per committed EpochChange,
+        # evaluated once the committed chain crosses its activation round.
+        self._handoffs: list[dict] = []
 
     def _violate(self, msg: str) -> None:
         _M_VIOLATIONS.inc()
@@ -90,6 +103,7 @@ class SafetyChecker:
         self._check_certificate(node, block)
         if getattr(block, "reconfig", None) is not None:
             self._check_reconfig(node, block)
+        self._check_handoffs(block)
 
     def _check_certificate(self, node: int, block) -> None:
         """Re-verify the committed block's embedded QC with the independent
@@ -157,7 +171,42 @@ class SafetyChecker:
         # node's EpochManager schedules it (pure chain content — see
         # reconfig.EpochManager.apply for why no commit-position input
         # is folded in). Idempotent per epoch.
-        self.schedule.apply(change.activation_round, change.committee())
+        if self.schedule.apply(change.activation_round, change.committee()):
+            self._handoffs.append(
+                {
+                    "carrier": block.round,
+                    "activation": change.activation_round,
+                    "epoch": change.new_epoch,
+                    "checked": False,
+                }
+            )
+
+    def _check_handoffs(self, block) -> None:
+        """The epoch-final handoff, re-derived from chain content alone:
+        once the committed chain reaches a change's activation round, a
+        pair of consecutive-round committed blocks (k, k+1) with
+        carrier <= k and k+1 < activation must already exist — the pair
+        whose second block's certificate made the carrier's commit
+        determined BEFORE the boundary. Its absence means the handoff
+        was completed by certificates formed at/after the boundary:
+        gap rounds certified by the old committee (the late-apply
+        pathology, now a hard violation)."""
+        for h in self._handoffs:
+            if h["checked"] or block.round < h["activation"]:
+                continue
+            h["checked"] = True
+            _M_CHECKS.inc()
+            complete = any(
+                k in self._by_round and k + 1 in self._by_round
+                for k in range(h["carrier"], h["activation"] - 1)
+            )
+            if not complete:
+                self._violate(
+                    f"epoch handoff violated: epoch {h['epoch']} carrier at "
+                    f"round {h['carrier']} was not 2-chain-final before its "
+                    f"activation round {h['activation']} — gap rounds were "
+                    "certified by the old committee"
+                )
 
     def ok(self) -> bool:
         return not self.violations

@@ -57,23 +57,55 @@ _M_RESTARTS = metrics.counter("chaos.restarts")
 _M_LATE_BOOTS = metrics.counter("chaos.late_boots")
 
 BASE_PORT = 25_000  # virtual — the transport keys on port, nothing binds
+# Synthetic payload-plane ports for EpochChange members (the chaos plane
+# orders digests from a deterministic mock, so nothing binds these
+# either — they exercise the wire format and the address registry).
+MEMPOOL_BASE_PORT = 35_000
 
 
 @dataclass(slots=True)
 class ReconfigDirective:
-    """Declarative epoch-reconfiguration for chaos scenarios: at virtual
-    time `at`, the orchestrator builds a signed EpochChange — successor
-    committee = genesis members minus `remove` plus `add` (node indices)
-    — activating `activation_margin` rounds past the currently committed
-    tip, and queues it on every running committee node's core; whichever
-    leads next carries it through the chain (the epoch-commit rule does
-    the rest). `proposer` indexes the authority whose key signs it."""
+    """Declarative epoch-reconfiguration for chaos scenarios: the
+    orchestrator builds a signed EpochChange — successor committee =
+    CURRENT committee minus `remove` plus `add` (node indices), or, in
+    the committee-free form, the current committee with its `rotate`
+    longest-serving members replaced by the next non-member indices
+    (cyclic, a pure function of the current membership and n — the form
+    matrix cells use, since it pins no node indices) — activating
+    `activation_margin` rounds past the currently committed tip, and
+    queues it on every running current-committee node's core; whichever
+    leads next carries it through the chain (the epoch-commit rule +
+    epoch-final handoff do the rest).
+
+    Directives may be chained (a list): each waits for its `at` time AND
+    for the previous boundary to be committed-past before building, so
+    rolling churn paces itself off real chain progress instead of wall
+    guesses. `proposer` indexes the signing authority; None picks the
+    lowest-index CURRENT member (required for chained directives, where
+    a fixed index may have rotated out)."""
 
     at: float
     add: tuple[int, ...] = ()
     remove: tuple[int, ...] = ()
+    rotate: int = 0
     activation_margin: int = 10
-    proposer: int = 0
+    proposer: int | None = None
+
+
+@dataclass(slots=True)
+class BoundaryCrash:
+    """Crash `nodes` the instant the FIRST epoch-switch event for
+    `epoch` is observed (i.e. at the handoff — right as the committed
+    change re-schedules the committee), restart them `down_s` virtual
+    seconds later. Deterministic under the virtual clock: the first
+    switch instant is a pure function of the seed. The restarted nodes
+    must reload their persisted epoch-final state (schedule + pending
+    handoffs) and never re-judge rounds their crashed incarnation
+    certified — the quorum-crash-at-activation-boundary scenario."""
+
+    epoch: int
+    nodes: tuple[int, ...]
+    down_s: float = 3.0
 
 
 @dataclass(slots=True)
@@ -159,7 +191,8 @@ class ChaosOrchestrator:
         scheduler_config: SchedulerConfig | None = None,
         telemetry_config: "telemetry.TelemetryConfig | None" = None,
         committee_indices: list[int] | None = None,
-        reconfig: ReconfigDirective | None = None,
+        reconfig: "ReconfigDirective | list[ReconfigDirective] | None" = None,
+        boundary_crashes: "list[BoundaryCrash] | None" = None,
         trusted_crypto: bool = False,
     ) -> None:
         self.rng = SeededRng(seed)
@@ -203,8 +236,28 @@ class ChaosOrchestrator:
                 for i in self.committee_indices
             ]
         )
-        self.reconfig = reconfig
-        self._own_store_dir = store_dir is None and bool(self.plan.crashes)
+        if reconfig is None:
+            self.reconfigs: list[ReconfigDirective] = []
+        elif isinstance(reconfig, ReconfigDirective):
+            self.reconfigs = [reconfig]
+        else:
+            self.reconfigs = list(reconfig)
+        # Rolling-churn bookkeeping: the membership (and epoch) the NEXT
+        # directive builds its successor from — advanced as each change
+        # is injected, so chained directives compose.
+        self._committee_now: list[int] = list(self.committee_indices)
+        self._epoch_now = 1
+        self._index_of = {pk: i for i, (pk, _s) in enumerate(self.keys)}
+        self.boundary_crashes = list(boundary_crashes or [])
+        self._bc_fired: set[int] = set()
+        self._bc_queue: asyncio.Queue = channel()
+        # Persistent stores whenever ANY restart can happen — plan crash
+        # windows or epoch-boundary crashes (a boundary-crashed node
+        # restarting against an empty in-memory store would re-commit
+        # from genesis, exactly the corruption persistence prevents).
+        self._own_store_dir = store_dir is None and (
+            bool(self.plan.crashes) or bool(boundary_crashes)
+        )
         if self._own_store_dir:
             store_dir = tempfile.mkdtemp(prefix="chaos-store-")
         self.store_dir = store_dir
@@ -268,6 +321,11 @@ class ChaosOrchestrator:
                 "epoch": committee.epoch,
                 "activation_round": activation_round,
                 "committee_size": committee.size(),
+                # Node indices of the epoch's membership: what the churn
+                # expectations judge full rotation by.
+                "members": sorted(
+                    self._index_of[pk] for pk in committee.sorted_keys()
+                ),
             }
             self.epoch_events.setdefault(i, []).append(entry)
             self.events.append(
@@ -275,8 +333,34 @@ class ChaosOrchestrator:
                     k: entry[k] for k in ("epoch", "activation_round")
                 }}
             )
+            # Boundary crashes arm off the FIRST switch event for their
+            # epoch. Executed by the run-scope watcher, never inline:
+            # this hook runs inside the switching node's own task tree,
+            # and crashing from there would cancel the crasher itself.
+            # Fired-set keys on the DIRECTIVE, not the epoch: a scenario
+            # may stagger several crash groups at one boundary.
+            for j, bc in enumerate(self.boundary_crashes):
+                if bc.epoch == committee.epoch and j not in self._bc_fired:
+                    self._bc_fired.add(j)
+                    self._bc_queue.put_nowait(bc)
 
         return hook
+
+    async def _boundary_crash_watcher(self) -> None:
+        while True:
+            bc = await self._bc_queue.get()
+            log.info(
+                "chaos: boundary crash at epoch %s — taking down nodes %s "
+                "for %.1fs",
+                bc.epoch,
+                list(bc.nodes),
+                bc.down_s,
+            )
+            for j in bc.nodes:
+                await self.crash(j)
+            await asyncio.sleep(bc.down_s)
+            for j in bc.nodes:
+                await self.restart(j)
 
     def _boot(self, i: int) -> None:
         node = self.nodes[i]
@@ -535,26 +619,8 @@ class ChaosOrchestrator:
             else:
                 await self.restart(who)
 
-    async def _drive_reconfig(self) -> None:
-        """Execute a ReconfigDirective: build the signed EpochChange from
-        the genesis committee ± the directive's node sets, activating
-        `activation_margin` rounds past the committed tip, and queue it on
-        every running committee node (whoever leads next proposes it).
-        Deterministic under the virtual clock: the committed tip at a
-        virtual instant is a pure function of the seed."""
-        d = self.reconfig
-        if d.at > 0:
-            await asyncio.sleep(d.at)
-        genesis = self.committee
-        members = []
-        for i, (pk, _seed) in enumerate(self.keys):
-            if i in d.remove:
-                continue
-            if pk in genesis.authorities or i in d.add:
-                members.append(
-                    (pk, genesis.stake(pk) or 1, ("127.0.0.1", BASE_PORT + i))
-                )
-        tip = max(
+    def _committed_tip(self) -> int:
+        return max(
             (
                 r
                 for commits in self.safety.commits.values()
@@ -562,30 +628,103 @@ class ChaosOrchestrator:
             ),
             default=0,
         )
-        author, seed = self.keys[d.proposer]
-        change = EpochChange.new_from_seed(
-            genesis.epoch + 1,
-            tip + d.activation_margin,
-            members,
-            author,
-            seed,
-        )
-        self.events.append(
-            {
-                "t": round(asyncio.get_running_loop().time(), 6),
-                "event": "reconfig_directive",
-                "epoch": change.new_epoch,
-                "activation_round": change.activation_round,
-            }
-        )
-        log.info("chaos: injecting %s", change)
-        for node in self.nodes:
-            if (
-                node.running
-                and node.core is not None
-                and node.pk in genesis.authorities
+
+    def _successor_indices(self, d: ReconfigDirective) -> list[int]:
+        """The next committee as node indices. `rotate` is committee-free:
+        drop the k longest-serving members (list-order head) and admit
+        the next k non-member indices cyclically after the current
+        maximum — a pure function of (current membership, n), so matrix
+        cells can run it at any committee size."""
+        current = list(self._committee_now)
+        if d.rotate:
+            # Clamp to the candidate pool: rotating more members than
+            # there are non-members to admit would spin the join picker.
+            k = min(d.rotate, len(current), self.n - len(current))
+            if k <= 0:
+                return current
+            survivors = current[k:]
+            joins: list[int] = []
+            cursor = (max(current) + 1) % self.n
+            while len(joins) < k:
+                if cursor not in current and cursor not in joins:
+                    joins.append(cursor)
+                cursor = (cursor + 1) % self.n
+            return survivors + joins
+        return [i for i in current if i not in d.remove] + [
+            i for i in d.add if i not in current
+        ]
+
+    async def _drive_reconfig(self) -> None:
+        """Execute the directive chain: each directive waits for its `at`
+        time AND for the previous epoch's boundary to be committed-past
+        (several EpochChanges in flight would otherwise race the
+        sequencing check — a carrier for epoch e+2 cannot ride a round
+        the schedule still maps to epoch e), then builds the signed
+        EpochChange from the CURRENT committee ± the directive's node
+        sets, activating `activation_margin` rounds past the committed
+        tip, and queues it on every running current-committee node
+        (whoever leads next proposes it). Deterministic under the
+        virtual clock: the committed tip at a virtual instant is a pure
+        function of the seed."""
+        loop = asyncio.get_running_loop()
+        start = loop.time()
+        prev_activation: int | None = None
+        for d in sorted(self.reconfigs, key=lambda d: d.at):
+            delay = start + d.at - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            while (
+                prev_activation is not None
+                and self._committed_tip() < prev_activation
             ):
-                node.core.schedule_reconfig(change)
+                await asyncio.sleep(0.25)
+            members_idx = self._successor_indices(d)
+            members = [
+                (
+                    self.keys[i][0],
+                    1,
+                    ("127.0.0.1", BASE_PORT + i),
+                    ("127.0.0.1", MEMPOOL_BASE_PORT + i),
+                )
+                for i in sorted(members_idx)
+            ]
+            proposer = (
+                d.proposer if d.proposer is not None else min(self._committee_now)
+            )
+            author, seed = self.keys[proposer]
+            change = EpochChange.new_from_seed(
+                self._epoch_now + 1,
+                self._committed_tip() + d.activation_margin,
+                members,
+                author,
+                seed,
+            )
+            self.events.append(
+                {
+                    "t": round(loop.time(), 6),
+                    "event": "reconfig_directive",
+                    "epoch": change.new_epoch,
+                    "activation_round": change.activation_round,
+                    "members": sorted(members_idx),
+                }
+            )
+            log.info("chaos: injecting %s", change)
+            current_keys = {self.keys[i][0] for i in self._committee_now}
+            for node in self.nodes:
+                if (
+                    node.running
+                    and node.core is not None
+                    and node.pk in current_keys
+                ):
+                    node.core.schedule_reconfig(change)
+            prev_activation = change.activation_round
+            # SENIORITY order, not sorted: _successor_indices drops the
+            # list head as "longest-serving", so survivors must keep
+            # their order and joins append at the tail — sorting here
+            # would make a wrapped rotation (n=4) evict the member that
+            # JUST joined and never rotate the real veterans out.
+            self._committee_now = list(members_idx)
+            self._epoch_now += 1
 
     # -- run -----------------------------------------------------------------
 
@@ -668,8 +807,13 @@ class ChaosOrchestrator:
                     self._boot_telemetry(loop)
                 if self.plan.crashes or self.plan.boots:
                     spawn(self._lifecycle(), name="chaos-lifecycle")
-                if self.reconfig is not None:
+                if self.reconfigs:
                     spawn(self._drive_reconfig(), name="chaos-reconfig")
+                if self.boundary_crashes:
+                    spawn(
+                        self._boundary_crash_watcher(),
+                        name="chaos-boundary-crash",
+                    )
                 deadline = start + duration
                 while loop.time() < deadline:
                     if self._target_met(min_commits, heal_t, start):

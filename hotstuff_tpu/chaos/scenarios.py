@@ -29,7 +29,12 @@ from .byzantine import (
     StaleReplayer,
     VoteWithholder,
 )
-from .orchestrator import BulkFlood, ChaosOrchestrator, ReconfigDirective
+from .orchestrator import (
+    BoundaryCrash,
+    BulkFlood,
+    ChaosOrchestrator,
+    ReconfigDirective,
+)
 from .plan import (
     CrashWindow,
     DelayedBoot,
@@ -95,9 +100,31 @@ class Scenario:
     # it run the full stack as JOIN candidates, admitted only by a
     # committed EpochChange (consensus/reconfig.py).
     committee: tuple[int, ...] | None = None
-    # Epoch-reconfiguration directive (orchestrator.ReconfigDirective
-    # factory): a signed committee change injected mid-run.
-    reconfig: Callable[[], ReconfigDirective] | None = None
+    # Size-parameterized genesis committee (receives the EFFECTIVE node
+    # count, after any matrix `n` override) — the committee-free form a
+    # grid reconfig scenario must use: membership derives from n instead
+    # of pinning indices, so cells can scale it. Takes precedence over
+    # `committee` when set.
+    committee_n: Callable[[int], tuple[int, ...]] | None = None
+    # Epoch-reconfiguration directives (orchestrator.ReconfigDirective
+    # factory): a signed committee change injected mid-run, or a LIST of
+    # chained directives (rolling churn — each waits for the previous
+    # boundary to be committed-past before building).
+    reconfig: Callable[[], "ReconfigDirective | list[ReconfigDirective]"] | None = None
+    # Size-parameterized directive factory (receives the effective n) —
+    # the committee-free form grid reconfig cells use; precedence over
+    # `reconfig` when set.
+    reconfig_n: Callable[[int], "list[ReconfigDirective]"] | None = None
+    # Quorum-crash-at-the-boundary machinery (orchestrator.BoundaryCrash
+    # factory list): crash nodes the instant an epoch switch lands.
+    boundary_crashes: Callable[[], list[BoundaryCrash]] | None = None
+    # Matrix-cell virtual-second budget override: None = the grid's
+    # MATRIX_CELL_DURATION_S cap (which bounds a REGRESSED cell's wall
+    # cost). Only a scenario whose CONTRACT structurally needs longer —
+    # rolling_churn's three progress-gated boundaries — declares one;
+    # everything else stays capped so cells remain comparable across
+    # matrix revisions.
+    cell_duration: float | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -1042,6 +1069,284 @@ _register(
 )
 
 
+# ---------------------------------------------------------------------------
+# Production-grade succession (ISSUE 15 / ROADMAP item 4): rolling committee
+# churn under the epoch-final handoff, quorum crashing at the activation
+# boundary, and a joiner range-syncing across several boundaries mid-batch.
+# All three are membership/topology/timing scenarios, so their tier-1 tests
+# run under the trusted-crypto stub (the PR 12 trust model: forgery is not
+# at stake here and exact pysigner dominates wall time); the matrix carries
+# an exact-crypto rolling_churn cell at n=4.
+
+_CHURN_EPOCHS = 3  # boundaries the committee rotates through
+_CHURN_MARGIN = 8  # activation margin per directive (rounds)
+
+
+def _churn_committee(n: int) -> tuple[int, ...]:
+    """Genesis committee for a size-n fleet: the first max(3, n//2)
+    indices — the rest are join candidates the rotation admits."""
+    return tuple(range(max(3, n // 2)))
+
+
+def _churn_rotate(n: int) -> int:
+    """Members replaced per boundary: a third of the committee (rounded
+    up), so _CHURN_EPOCHS boundaries replace every genesis member."""
+    c = len(_churn_committee(n))
+    return max(1, (c + 2) // 3)
+
+
+def _churn_directives(n: int) -> list[ReconfigDirective]:
+    k = _churn_rotate(n)
+    # `at` times are lower bounds only: each directive additionally waits
+    # for the previous boundary to be committed-past (the orchestrator's
+    # progress gate), so churn paces itself off real chain progress.
+    return [
+        ReconfigDirective(at=t, rotate=k, activation_margin=_CHURN_MARGIN)
+        for t in (1.5, 2.5, 3.5)
+    ]
+
+
+def _switch_memberships(report: dict) -> tuple[list[str], dict]:
+    """Fold per-node epoch-switch events into epoch -> (activation,
+    members), flagging any disagreement (the unanimity contract)."""
+    problems: list[str] = []
+    by_epoch: dict[int, set] = {}
+    for evs in report.get("epoch_switches", {}).values():
+        for e in evs:
+            by_epoch.setdefault(e["epoch"], set()).add(
+                (e["activation_round"], tuple(e.get("members", ())))
+            )
+    folded = {}
+    for epoch in sorted(by_epoch):
+        if len(by_epoch[epoch]) != 1:
+            problems.append(
+                f"nodes disagree on epoch {epoch}'s boundary/membership: "
+                f"{sorted(by_epoch[epoch])}"
+            )
+        else:
+            act, members = next(iter(by_epoch[epoch]))
+            folded[epoch] = (act, members)
+    return problems, folded
+
+
+def _expect_no_handoff_violation(deltas: dict) -> list[str]:
+    """The hard invariant the epoch-final handoff establishes: a commit
+    may never land past its declared activation round."""
+    late = deltas.get("reconfig.late_applies", 0)
+    if late:
+        return [
+            f"epoch handoff violated: reconfig.late_applies = {late} "
+            "(a commit landed at/past its declared activation round)"
+        ]
+    return []
+
+
+def _expect_rolling_churn(report: dict, deltas: dict) -> list[str]:
+    n = report["nodes"]
+    genesis = set(_churn_committee(n))
+    problems = _expect_no_handoff_violation(deltas)
+    problems += _expect_counter(
+        deltas, "reconfig.proposed", minimum=_CHURN_EPOCHS
+    )
+    problems += _expect_counter(
+        deltas, "reconfig.epoch_switches", minimum=_CHURN_EPOCHS
+    )
+    disagreements, memberships = _switch_memberships(report)
+    problems += disagreements
+    expected = set(range(2, 2 + _CHURN_EPOCHS))
+    if not expected <= set(memberships):
+        problems.append(
+            f"committee did not rotate through epochs {sorted(expected)}: "
+            f"saw {sorted(memberships)}"
+        )
+        return problems
+    if disagreements:
+        return problems
+    # FULL rotation: every genesis member rotated out at some boundary.
+    for g in sorted(genesis):
+        if all(g in members for _act, members in memberships.values()):
+            problems.append(f"genesis member {g} never rotated out")
+    # Per-node commit floors, scaled by the committee geometry: every
+    # FINAL-committee member holds a participation floor, and members
+    # past the last boundary must carry QUORUM weight of the final
+    # committee — the committee demonstrably works as a committee. (Not
+    # every-member: at fleet sizes a few joiners can still be mid
+    # catch-up at cutoff without any liveness defect; at the default
+    # n=6 the final committee is 3-of-3, so quorum = everyone and the
+    # tier-1 pin stays maximal.)
+    final_act, final_members = memberships[max(expected)]
+    past_boundary = 0
+    for i in sorted(final_members):
+        rounds = [r for r, _d in report["commits"].get(str(i), [])]
+        if len(rounds) < 3:
+            problems.append(
+                f"final-committee node {i} committed {len(rounds)} blocks (< 3)"
+            )
+        elif max(rounds) > final_act:
+            past_boundary += 1
+    quorum = 2 * len(final_members) // 3 + 1
+    if past_boundary < quorum:
+        problems.append(
+            f"only {past_boundary} of {len(final_members)} final-committee "
+            f"members committed past the last boundary {final_act} "
+            f"(quorum {quorum})"
+        )
+    # Joiners demonstrably used batched range sync, and the safety
+    # checker audited the run (its own epoch-final schedule included).
+    problems += _expect_counter(deltas, "sync.range_requests")
+    problems += _expect_counter(deltas, "sync.range_blocks", minimum=3)
+    problems += _expect_counter(deltas, "chaos.invariant_checks")
+    return problems
+
+
+_register(
+    Scenario(
+        name="rolling_churn",
+        description="The committee FULLY rotates over three committed "
+        "epoch boundaries while traffic runs: chained committee-free "
+        "rotation directives (a third of the committee per boundary, "
+        "paced off real chain progress), every genesis member departs, "
+        "every joiner range-syncs across the prior boundaries and "
+        "commits past the last one, all under the epoch-final handoff — "
+        "reconfig.late_applies must stay ZERO and the SafetyChecker's "
+        "independently derived epoch schedule must agree at every step.",
+        n=6,
+        committee_n=_churn_committee,
+        plan=lambda: FaultPlan(default_link=LinkFaults(delay=0.1)),
+        reconfig_n=_churn_directives,
+        # Three progress-gated boundaries + a joiner catch-up stall per
+        # boundary (small committees need every member, so each admission
+        # costs a few pacemaker rounds) + post-final-boundary traffic.
+        duration=45.0,
+        cell_duration=45.0,  # the matrix cell needs the full contract too
+        min_commits=0,  # no early stop: all three boundaries must play out
+        expect=_expect_rolling_churn,
+    )
+)
+
+
+def _expect_boundary_quorum_crash(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_no_handoff_violation(deltas)
+    problems += _expect_counter(deltas, "chaos.crashes", minimum=3)
+    problems += _expect_counter(deltas, "chaos.restarts", minimum=3)
+    problems += _expect_counter(deltas, "reconfig.epoch_switches")
+    disagreements, memberships = _switch_memberships(report)
+    problems += disagreements
+    if 2 not in memberships:
+        return problems + ["the epoch-2 boundary never landed"]
+    act, _members = memberships[2]
+    # The crashed quorum must come back on epoch 2 (persisted epoch-final
+    # state reloaded — or the pending handoff replayed to completion) and
+    # commit PAST the boundary it crashed at.
+    finals = report.get("final_epochs", {})
+    for i in ("0", "1", "2"):
+        if finals.get(i) != 2:
+            problems.append(
+                f"restarted node {i} ended on epoch {finals.get(i)}, not 2 "
+                "(persisted epoch-final state not recovered)"
+            )
+        rounds = [r for r, _d in report["commits"].get(i, [])]
+        if not any(r > act for r in rounds):
+            problems.append(
+                f"restarted node {i} never committed past the boundary {act}"
+            )
+    # Progress resumed AFTER the restarts (the boundary crash healed).
+    restarts = [
+        e["t"] for e in report["events"] if e["event"] == "restart"
+    ]
+    if restarts:
+        heal = max(restarts)
+        resumed = any(
+            t > heal
+            for times in report.get("commit_times", {}).values()
+            for t in times
+        )
+        if not resumed:
+            problems.append(
+                f"no commit after the last restart at t={heal} — the "
+                "boundary crash never healed"
+            )
+    return problems
+
+
+_register(
+    Scenario(
+        name="boundary_quorum_crash",
+        description="A quorum of the old committee (nodes 0-2 of "
+        "{0,1,2,3}) crashes the INSTANT the first epoch-2 switch lands — "
+        "the worst place to die: some victims have applied and persisted "
+        "the boundary, some still hold only the pending handoff. On "
+        "restart every victim must reload its epoch-final state (schedule "
+        "+ pending wall), never re-judge rounds its crashed incarnation "
+        "certified, and the fleet must commit past the boundary with "
+        "reconfig.late_applies still zero.",
+        n=5,
+        committee=(0, 1, 2, 3),
+        plan=lambda: FaultPlan(default_link=_CATCHUP_LINK),
+        reconfig=lambda: ReconfigDirective(
+            at=2.0, add=(4,), remove=(3,), activation_margin=10
+        ),
+        boundary_crashes=lambda: [
+            BoundaryCrash(epoch=2, nodes=(0, 1, 2), down_s=3.0)
+        ],
+        duration=25.0,
+        min_commits=0,  # no early stop: crash + recovery must play out
+        expect=_expect_boundary_quorum_crash,
+    )
+)
+
+
+def _expect_multi_epoch_catchup(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_no_handoff_violation(deltas)
+    problems += _expect_counter(deltas, "reconfig.epoch_switches")
+    disagreements, memberships = _switch_memberships(report)
+    problems += disagreements
+    if not {2, 3} <= set(memberships):
+        return problems + [
+            f"both boundaries must land: saw epochs {sorted(memberships)}"
+        ]
+    boots = [e for e in report["events"] if e["event"] == "boot"]
+    if [e["node"] for e in boots] != [5]:
+        problems.append(f"expected one late boot of node 5, saw {boots}")
+    # The late joiner crossed BOTH boundaries inside its range-synced
+    # batches (its store was empty at boot) and ended on the live epoch,
+    # near the live tip.
+    if report.get("final_epochs", {}).get("5") != 3:
+        problems.append(
+            f"late joiner ended on epoch "
+            f"{report.get('final_epochs', {}).get('5')}, not 3"
+        )
+    problems += _expect_catchup(report, deltas, node=5)
+    return problems
+
+
+_register(
+    Scenario(
+        name="multi_epoch_catchup",
+        description="Two chained epoch boundaries land ({0,1,2,3} -> "
+        "{1,2,3,4} -> {2,3,4,5}) and THEN node 5 — admitted by the second "
+        "change — boots for the first time with an EMPTY store: one "
+        "genesis range sync must replay the chain THROUGH both committed "
+        "boundaries (epoch switches committed mid-batch govern the blocks "
+        "after them), leaving the joiner on the live epoch within the "
+        "tip-lag bound.",
+        n=6,
+        committee=(0, 1, 2, 3),
+        plan=lambda: FaultPlan(
+            default_link=_CATCHUP_LINK,
+            boots=[DelayedBoot(node=5, at=10.0)],
+        ),
+        reconfig=lambda: [
+            ReconfigDirective(at=1.5, add=(4,), remove=(0,), activation_margin=10),
+            ReconfigDirective(at=2.5, add=(5,), remove=(1,), activation_margin=10),
+        ],
+        duration=18.0,
+        min_commits=0,  # no early stop: both boundaries + the boot play out
+        expect=_expect_multi_epoch_catchup,
+    )
+)
+
+
 # The short sweep tier-1 runs (and the CLI's --scenario all default).
 SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 
@@ -1058,12 +1363,17 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 # the artifact carries BOTH frames-per-stalled-round numbers (the
 # `timeout_plane` block per cell) and the O(n²) -> O(n·fanout) win is a
 # committed, regression-tracked delta.
+# rolling_churn is the grid's reconfig cell (ISSUE 15): committee-free
+# by construction (committee_n + rotation directives derive membership
+# from n), exact crypto at n=4, trusted-stub at n=64, with per-node
+# commit floors scaled by the committee geometry in its expectation.
 MATRIX_SCENARIOS = (
     "baseline",
     "lossy_links",
     "leader_crash",
     "timeout_storm",
     "timeout_storm_legacy",
+    "rolling_churn",
 )
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)
@@ -1100,7 +1410,7 @@ def run_matrix_cell(
     n: int,
     trusted: str = "auto",
     wan: bool = True,
-    duration: float | None = MATRIX_CELL_DURATION_S,
+    duration: float | None = None,
 ) -> dict:
     """Execute one matrix cell and distill it to the committed record:
     verdict + fleet telemetry rollup (utils/telemetry.fleet_rollup), with
@@ -1119,6 +1429,14 @@ def run_matrix_cell(
     trusted_crypto = (
         trusted == "on" or (trusted == "auto" and n >= TRUSTED_CRYPTO_MIN_N)
     )
+    if duration is None:
+        # The cell cap bounds a REGRESSED cell's wall cost; only a
+        # scenario that declares a cell_duration (rolling_churn's three
+        # progress-gated boundaries) gets a longer budget — truncating
+        # it would fail the cell for want of virtual time, not health,
+        # while un-capping every long scenario would make legacy cells
+        # non-comparable across matrix revisions.
+        duration = SCENARIOS[scenario].cell_duration or MATRIX_CELL_DURATION_S
     t0 = _time.perf_counter()
     report = run_scenario(
         scenario,
@@ -1205,6 +1523,16 @@ def run_scenario(
             f"{scenario.committee}; its node count cannot be overridden"
         )
     effective_n = n if n is not None else scenario.n
+    committee_indices = (
+        list(scenario.committee_n(effective_n))
+        if scenario.committee_n is not None
+        else (list(scenario.committee) if scenario.committee is not None else None)
+    )
+    reconfig = (
+        scenario.reconfig_n(effective_n)
+        if scenario.reconfig_n is not None
+        else (scenario.reconfig() if scenario.reconfig else None)
+    )
     plan = (
         scenario.plan_n(effective_n)
         if scenario.plan_n is not None
@@ -1230,10 +1558,11 @@ def run_scenario(
             flood=scenario.flood() if scenario.flood else None,
             scheduler_config=scenario.scheduler() if scenario.scheduler else None,
             telemetry_config=telemetry_config,
-            committee_indices=(
-                list(scenario.committee) if scenario.committee is not None else None
+            committee_indices=committee_indices,
+            reconfig=reconfig,
+            boundary_crashes=(
+                scenario.boundary_crashes() if scenario.boundary_crashes else None
             ),
-            reconfig=scenario.reconfig() if scenario.reconfig else None,
             trusted_crypto=trusted_crypto,
         )
         report = await orch.run(

@@ -84,6 +84,7 @@ _M_ROUND = metrics.gauge("consensus.round")
 _M_PROPOSAL_TO_VOTE = metrics.histogram("consensus.proposal_to_vote_s")
 _M_COMMIT_LATENCY = metrics.histogram("consensus.commit_latency_s")
 _M_RECONFIG_PROPOSED = metrics.counter("reconfig.proposed")
+_M_HANDOFF_COMMITS = metrics.counter("reconfig.handoff_commits")
 _M_RANGE_SERVED = metrics.counter("sync.range_served")
 _M_RANGE_REPLIES = metrics.counter("sync.range_replies")
 _M_RANGE_BLOCKS = metrics.counter("sync.range_blocks")
@@ -149,6 +150,23 @@ class Core:
         # all-to-all baseline).
         self.overlay = OverlayRouter(self, overlay_regions)
         self.timer: Timer | None = None  # created inside the running loop
+        # Newest TC this node processed or assembled: the lag-recovery
+        # answer for a peer whose pacemaker is one round behind (see
+        # _handle_timeout). TCs are otherwise fire-and-forget, and a
+        # node that misses one stays a round behind the fleet for the
+        # rest of a stall — fatal when the committee's quorum needs
+        # every member (small post-churn committees).
+        self.last_tc: TC | None = None
+        # Lag-recovery reply dedup: author -> (last_tc.round sent, when).
+        # The stale-timeout branch deliberately spends no crypto, so an
+        # unauthenticated flood forging a staked author could otherwise
+        # reflect one full TC (O(n) signatures) per tiny frame at that
+        # author's registered address; capping at one reply per (author,
+        # TC round) per pacemaker period bounds the amplification to the
+        # laggard's own honest re-timeout cadence while still re-serving
+        # a reply the network dropped. Keys are stake-gated, so the map
+        # is committee-bounded.
+        self._lag_replies: dict[PublicKey, tuple[Round, float]] = {}
         # EpochChange queued for this node's next proposal (schedule_reconfig)
         self._pending_reconfig: EpochChange | None = None
         # Single-slot serve cache for chained range-sync batches:
@@ -183,6 +201,14 @@ class Core:
             or change.activation_round < self.round + MIN_ACTIVATION_MARGIN
         ):
             self._pending_reconfig = None  # applied elsewhere, or too late
+            return None
+        if self.epochs.epoch_for_round(self.round) + 1 != change.new_epoch:
+            # Applied but not yet ACTIVE predecessor boundary: a carrier
+            # proposed now would ride a round the schedule still maps to
+            # the pre-predecessor epoch and fail every replica's
+            # sequencing check. Keep it queued until rounds cross the
+            # previous activation boundary (the rolling-churn shape:
+            # several EpochChanges in flight back to back).
             return None
         return change
 
@@ -254,7 +280,16 @@ class Core:
     # -- voting & committing -------------------------------------------------
 
     async def _make_vote(self, block: Block) -> Vote | None:
-        """Safety rules (core.rs:106-123)."""
+        """Safety rules (core.rs:106-123), plus the epoch-final
+        certification wall: while a next-epoch handoff is pending, this
+        node refuses to help certify any round at or past the declared
+        activation boundary — the old committee certifies THROUGH the
+        epoch-final position and owns nothing after it, which is what
+        makes a late-landing commit unable to re-map gap rounds
+        (consensus/reconfig.py, §5.5j)."""
+        if self.epochs.handoff_blocks(block.round):
+            self.epochs.note_hold(block.round, "vote")
+            return None
         safety_rule_1 = block.round > self.last_voted_round
         safety_rule_2 = block.qc.round + 1 == block.round
         if block.tc is not None:
@@ -302,6 +337,13 @@ class Core:
                 break
             to_commit.append(parent)
         self.last_committed_round = block.round
+        # Persist the floor BEFORE announcing the commit: the epoch-
+        # boundary crash scenarios land a crash inside the commit path
+        # (the switch hook fires here), and a floor that only becomes
+        # durable at the NEXT vote would make the restarted node
+        # re-commit its newest block — the monotonicity violation the
+        # persisted safety state exists to prevent.
+        await self._store_safety_state()
         # Commit-path synchronizer hygiene: the committed floor gates the
         # range-sync threshold, and fetches/waiters for branches at or
         # below it are abandoned forks to reclaim (the old leak).
@@ -323,6 +365,10 @@ class Core:
                 await self.epochs.apply(
                     b.reconfig, store=self.store, trigger_round=trigger.round
                 )
+        # Handoff hygiene: a pending change whose every carrier the
+        # committed chain just passed WITHOUT applying rode a dead fork —
+        # drop it so its boundary stops walling certification.
+        await self.epochs.note_commit(self.last_committed_round, store=self.store)
         for b in reversed(to_commit):
             d = b.digest()
             _M_COMMITS.inc()
@@ -346,6 +392,18 @@ class Core:
 
     async def _process_qc(self, qc: QC) -> None:
         """Adopt a higher QC and advance past its round (core.rs:263-276,321)."""
+        if self.epochs.handoff_pending() and not qc.is_genesis():
+            # Epoch-final commit unlock: with a handoff pending, the
+            # observation that completes the carrier's 2-chain may never
+            # arrive inside a block — when the completing pair hugs the
+            # boundary, the QC on the pair's second block can only ride
+            # a WALLED round's proposal; and a catch-up node may hold
+            # the full pair plus its certificate (range-synced store +
+            # a timeout's high_qc) while the wedged fleet produces no
+            # further blocks at all. Commit straight off the adopted
+            # certificate here; outside a pending handoff this path
+            # never runs, so historical replay is byte-identical.
+            await self._try_handoff_commit(qc)
         if qc.round > self.high_qc.round and tracing.enabled():
             # QC-assembly stage on NON-assembling nodes: the first time
             # this node sees a quorum certificate for the block.
@@ -363,10 +421,54 @@ class Core:
         if qc.round > self.high_qc.round:
             self.high_qc = qc
 
+    async def _try_handoff_commit(self, qc: QC) -> None:
+        """Commit off an adopted certificate at the epoch-final edge: if
+        `qc` certifies a stored block b1 whose own QC is consecutive
+        (b0.round + 1 == b1.round), the 2-chain for b0 is complete — the
+        observation normally arrives inside the NEXT block, which the
+        wall may forbid. The commit trigger round is b1's (the round of
+        the completing certificate), the honest local commit position."""
+        if qc.round <= self.last_committed_round:
+            return
+        raw = await self.store.read(qc.hash.data)
+        if raw is None:
+            return
+        b1 = Block.decode(Reader(raw))
+        if b1.qc.is_genesis() or b1.qc.round + 1 != b1.round:
+            return
+        raw0 = await self.store.read(b1.parent().data)
+        if raw0 is None:
+            return
+        b0 = Block.decode(Reader(raw0))
+        if b0.round <= self.last_committed_round:
+            return
+        _M_HANDOFF_COMMITS.inc()
+        log.info(
+            "Handoff commit unlock: QC at round %s completes the 2-chain "
+            "below the epoch boundary",
+            qc.round,
+        )
+        await self._commit(b0, b1, b1)
+
     async def _advance_round(self, round_: Round) -> None:
         if round_ < self.round:
             return
-        self.round = round_ + 1
+        target = round_ + 1
+        boundary = self.epochs.handoff_boundary()
+        if boundary is not None and target > boundary:
+            # Epoch-final wall, pacemaker side: while a handoff is
+            # pending, this node may ENTER the boundary round (where the
+            # successor committee's first traffic lands) but not cross
+            # it — the rounds past the boundary belong to a committee it
+            # has not committed yet. Crossing anyway (via old-committee
+            # TCs formed during the stall) would strand it: everything
+            # arriving at the boundary round becomes "stale", including
+            # the very certificates whose fetch would complete its
+            # handoff (the 64-node churn wedge).
+            if boundary <= self.round:
+                return
+            target = boundary
+        self.round = target
         _M_ROUND.set(self.round)
         # The epoch manager's current() (broadcast fan-out, synchronizer
         # peer picks) follows the newest round this core has reached.
@@ -437,6 +539,14 @@ class Core:
 
     async def _generate_proposal(self, tc: TC | None) -> None:
         """Leader path (core.rs:278-318)."""
+        if self.epochs.handoff_blocks(self.round):
+            # Epoch-final wall, proposer side: nothing the old committee
+            # proposes at or past a pending boundary may be certified, so
+            # do not even ask — the round falls to the pacemaker until
+            # the carrier's commit lands (then the successor committee
+            # owns these rounds).
+            self.epochs.note_hold(self.round, "proposal")
+            return
         t0 = time.perf_counter()
         payload = await self.mempool_driver.get(self.parameters.max_payload_size)
         payload_dur = time.perf_counter() - t0
@@ -454,6 +564,12 @@ class Core:
             _M_RECONFIG_PROPOSED.inc()
             log.info(
                 "Proposing %s in B%s", reconfig, block.round
+            )
+            # The proposer arms its OWN wall too: its proposal bypasses
+            # _handle_proposal (it goes straight to _process_block), so
+            # this is where the leader's pending handoff is recorded.
+            await self.epochs.note_pending(
+                reconfig, block.round, store=self.store
             )
         if tracing.enabled():
             tid = tracing.trace_id(block.round, digest.data)
@@ -547,29 +663,44 @@ class Core:
                 # Epoch sequencing + activation-margin admission (the
                 # signature already rode the verify_async group).
                 self.epochs.validate(block.reconfig, block.round)
+                # Epoch-final handoff: an admitted carrier arms the
+                # certification wall at its declared boundary until its
+                # commit lands (persisted — a crash here must wake with
+                # the wall intact).
+                await self.epochs.note_pending(
+                    block.reconfig, block.round, store=self.store
+                )
         except ConsensusError:
             if (
-                block.round > self.round + RANGE_SYNC_THRESHOLD
+                block.round > self.last_committed_round + RANGE_SYNC_THRESHOLD
                 and await self.store.read(block.parent().data) is None
             ):
-                # Catch-up seam: a block this far past our round may be
-                # certified by a committee epoch we have not COMMITTED yet
-                # (reconfig.py), in which case every check above judges it
-                # with stale epoch knowledge. Park it unverified, fetch
-                # its claimed ancestry (range sync), and re-validate from
-                # scratch when the parent arrives. Nothing is trusted
-                # until that second pass succeeds. The parent-missing
-                # guard matters: with the parent present this IS the
-                # second pass — a failure now is genuine garbage, and
-                # re-parking it would spin (the waiter fires instantly).
+                # Catch-up seam: a block this far past our COMMITTED floor
+                # may be certified by a committee epoch we have not
+                # committed yet (reconfig.py), in which case every check
+                # above judges it with stale epoch knowledge. Park it
+                # unverified, fetch its claimed ancestry (range sync),
+                # and re-validate from scratch when the parent arrives.
+                # Nothing is trusted until that second pass succeeds. The
+                # floor (not self.round) is the right yardstick: a joiner
+                # admitted at an epoch boundary ADVANCES its round by
+                # adopting certified high_qcs from the stall-round
+                # timeouts around it while owning none of the chain — a
+                # round-relative gate would then reject every proposal
+                # (stale-epoch leader check) without ever fetching
+                # ancestry, wedging the whole committee when the joiner
+                # is needed for quorum. The parent-missing guard matters:
+                # with the parent present this IS the second pass — a
+                # failure now is genuine garbage, and re-parking it would
+                # spin (the waiter fires instantly).
                 if await self.synchronizer.fetch_unverified(block):
                     _M_PARKED.inc()
                     log.info(
-                        "parking unverifiable B%s (%s rounds past local "
-                        "round %s) pending ancestry sync",
+                        "parking unverifiable B%s (%s rounds past the "
+                        "committed floor %s) pending ancestry sync",
                         block.round,
-                        block.round - self.round,
-                        self.round,
+                        block.round - self.last_committed_round,
+                        self.last_committed_round,
                     )
                     return
             raise
@@ -587,6 +718,7 @@ class Core:
                 )
         await self._process_qc(block.qc)
         if block.tc is not None:
+            self._note_tc(block.tc)
             await self._advance_round(block.tc.round)
         t0 = time.perf_counter()
         available = await self.mempool_driver.verify(block)
@@ -617,14 +749,99 @@ class Core:
             if self.leader_elector.get_leader(self.round) == self.name:
                 await self._generate_proposal(None)
 
+    def _note_tc(self, tc: TC) -> None:
+        if self.last_tc is None or tc.round > self.last_tc.round:
+            self.last_tc = tc
+
     async def _handle_timeout(self, timeout: Timeout) -> None:
         if timeout.round < self.round:
+            # Lag recovery: a timeout a few rounds behind us is the
+            # signature of a peer that missed the TCs which advanced the
+            # rest of the fleet (TCs are fire-and-forget). Re-serve our
+            # newest TC directly — it advances the laggard past every
+            # missed round in one hop. Without this, a committee whose
+            # quorum needs the lagging members (post-churn committees,
+            # joiners exiting their handoff a few stall-rounds behind)
+            # wedges with each side re-timing-out rounds the other is
+            # not in. Bounded: only lag within the range-sync threshold
+            # (deeper lag rides the range-sync paths), only for a
+            # claimed author with stake in the stale round's OR the
+            # current round's committee (a joiner stuck at a boundary
+            # is a member of the next epoch only), one direct frame per
+            # received timeout, no crypto spent on the stale frame.
+            now = asyncio.get_running_loop().time()
+            prev = self._lag_replies.get(timeout.author)
+            fresh = (
+                prev is None
+                or prev[0] != self.last_tc.round
+                or (now - prev[1]) * 1000.0 >= self.parameters.timeout_delay
+            ) if self.last_tc is not None else False
+            if (
+                fresh
+                and timeout.round >= self.round - RANGE_SYNC_THRESHOLD
+                and self.last_tc.round >= timeout.round
+                and (
+                    self.epochs.committee_for_round(timeout.round).stake(
+                        timeout.author
+                    )
+                    > 0
+                    or self.epochs.committee_for_round(self.round).stake(
+                        timeout.author
+                    )
+                    > 0
+                )
+            ):
+                self._lag_replies[timeout.author] = (self.last_tc.round, now)
+                await self._transmit(self.last_tc, timeout.author, urgent=True)
             return
-        await timeout.verify_async(self.epochs, self.verification_service)
+        try:
+            await timeout.verify_async(self.epochs, self.verification_service)
+        except ConsensusError:
+            # Stale-epoch bootstrap (synchronizer.fetch_certified): a
+            # timeout we cannot verify whose high_qc sits far past our
+            # committed floor is the signature of a node that missed one
+            # or more epoch boundaries — and when the committee needs
+            # THIS node for quorum, these timeouts are the only traffic
+            # that will ever arrive. Fetch the certified ancestry; the
+            # replay installs the committed epoch switches, then live
+            # traffic verifies. The timeout itself stays rejected.
+            qc = timeout.high_qc
+            if (
+                not qc.is_genesis()
+                and qc.round
+                > self.last_committed_round + RANGE_SYNC_THRESHOLD
+                and await self.synchronizer.fetch_certified(qc.hash, qc.round)
+            ):
+                _M_PARKED.inc()
+                log.info(
+                    "unverifiable timeout at round %s: bootstrapping range "
+                    "sync from its high_qc (round %s, floor %s)",
+                    timeout.round,
+                    qc.round,
+                    self.last_committed_round,
+                )
+                return
+            raise
         await self._process_qc(timeout.high_qc)
+        hqc = timeout.high_qc
+        if (
+            not hqc.is_genesis()
+            and hqc.round > self.last_committed_round
+            and await self.store.read(hqc.hash.data) is None
+        ):
+            # Certified-gap closure: this VERIFIED high_qc certifies a
+            # block we never received. During a stall a node can run
+            # ahead of its floor by adopting such certificates — and
+            # once the whole committee waits on it at a boundary, no
+            # future proposal will ever deliver the missing ancestry
+            # (rounds cannot form without this node). Fetch the
+            # certified block directly; its ancestry cascade and the
+            # replayed epoch switches close the floor gap.
+            await self.synchronizer.fetch_certified(hqc.hash, hqc.round)
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
             log.debug("assembled %s", tc)
+            self._note_tc(tc)
             await self._advance_round(tc.round)
             await self._transmit(tc, None)
             if self.leader_elector.get_leader(self.round) == self.name:
@@ -754,6 +971,7 @@ class Core:
                     tc.round,
                     len(tc.votes),
                 )
+                self._note_tc(tc)
                 await self._advance_round(tc.round)
                 await self._transmit(tc, None)
                 if self.leader_elector.get_leader(self.round) == self.name:
@@ -764,6 +982,7 @@ class Core:
     async def _handle_tc(self, tc: TC) -> None:
         """A TC received directly (core.rs:438-444)."""
         await tc.verify_async(self.epochs, self.verification_service)
+        self._note_tc(tc)
         await self._advance_round(tc.round)
         if self.leader_elector.get_leader(self.round) == self.name:
             await self._generate_proposal(tc)

@@ -236,7 +236,13 @@ class Block:
     signature: Signature
     # Optional committee-succession payload (consensus/reconfig.py): the
     # block digest commits to it, and the new committee activates only
-    # once THIS block is 2-chain committed (the epoch-commit rule).
+    # once THIS block is 2-chain committed (the epoch-commit rule). A
+    # carrying block is an EPOCH-FINAL POSITION: honest nodes that
+    # admitted it refuse to certify rounds at or past the declared
+    # activation until the commit lands, so the old committee certifies
+    # through the boundary minus one and the successor owns everything
+    # after — no certificate in the committed chain can ever be judged
+    # by the wrong epoch's committee (§5.5j).
     reconfig: EpochChange | None = None
     # digest cache: read on every vote/store/commit/sync touch
     _digest: Digest | None = field(

@@ -221,6 +221,64 @@ class Synchronizer:
         await self._register(block.parent(), block, reverify=True)
         return True
 
+    async def fetch_certified(self, digest: Digest, round_: Round) -> bool:
+        """Fetch a block we only know as a CERTIFICATE reference (a
+        Timeout's embedded high_qc hash) that our store lacks.
+
+        Two callers, one mechanism (consensus/core._handle_timeout):
+
+        * stale-epoch BOOTSTRAP — a joiner admitted at an epoch boundary
+          (or a node that missed several boundaries) may be unable to
+          verify ANY live traffic, while the committee needs that very
+          node for quorum, so no proposals flow and the proposal-parking
+          seam (`fetch_unverified`) never fires; the unverifiable
+          timeouts' high_qc still names a chain position to fetch;
+        * certified-gap CLOSURE — a verified timeout's high_qc certifies
+          a block we never received (the node ran ahead of its floor by
+          adopting certificates during a stall); nothing else would ever
+          deliver the block, since proposals reference it only as
+          ancestry of FUTURE rounds that cannot form while the committee
+          waits for this node.
+
+        Nothing is trusted from the container — the digest only directs
+        which ancestry to fetch, and every served block re-runs full
+        validation. Per-digest for small gaps, batched range sync past
+        RANGE_SYNC_THRESHOLD. Bounded: digest dedup, WAITING_CAP, and a
+        one-range-pipeline gate (one catch-up at a time)."""
+        gap = round_ - self._committed_round
+        if gap <= 0:
+            return False
+        if digest in self._pending or digest in self._waiting:
+            return False
+        ranged = gap > RANGE_SYNC_THRESHOLD
+        if ranged and any(f.ranged for f in self._pending.values()):
+            return False  # an active pipeline is already closing the gap
+        if len(self._waiting) >= WAITING_CAP:
+            return False
+        if await self.store.read(digest.data) is not None:
+            return False
+        fetch = _Fetch(
+            ts=asyncio.get_running_loop().time(), round=round_, ranged=ranged
+        )
+        self._pending[digest] = fetch
+        self._waiting[digest] = (
+            spawn(
+                self._certified_waiter(digest),
+                name=f"sync-certified-{digest.short()}",
+            ),
+            round_,
+        )
+        await self._send(digest, fetch)
+        return True
+
+    async def _certified_waiter(self, digest: Digest) -> None:
+        # No re-injection: the range replay already ran every block
+        # (including the target) through the full proposal path — this
+        # waiter only reclaims the fetch/waiting entries on arrival.
+        await self.store.notify_read(digest.data)
+        self._pending.pop(digest, None)
+        self._waiting.pop(digest, None)
+
     async def _register(
         self, parent: Digest, block: Block, reverify: bool
     ) -> None:

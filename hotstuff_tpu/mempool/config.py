@@ -78,6 +78,94 @@ class MempoolCommittee:
         return MempoolCommittee(auths, obj.get("epoch", 1))
 
 
+class MempoolEpochView:
+    """Epoch-aware mempool committee: the payload plane's half of the
+    epoch-final handoff (consensus/reconfig.py, §5.5j).
+
+    The genesis MempoolCommittee is static config; this view resolves
+    membership through the node's shared EpochManager instead, so
+    payload gossip fan-out, sync serving/requesting and address lookup
+    cross an epoch boundary at the SAME position as consensus (the
+    declared activation round — the manager's round hint is advanced by
+    the consensus core, and both planes read one schedule):
+
+      * `broadcast_addresses` — gossip fans out to the CURRENT epoch's
+        committee only: a joiner starts receiving payload gossip at the
+        switch, a leaver stops at it.
+      * `exists` — payload acceptance spans every KNOWN epoch: blocks
+        near the boundary still reference payloads authored by the
+        adjacent epoch's members, and availability (not authorship
+        admission) is the payload plane's contract — ordering authority
+        stays with consensus.
+      * `mempool_address` — resolves through the manager's payload-plane
+        registry (genesis seeds it, applied EpochChanges extend it), so
+        a JOINER's payloads become fetchable exactly at the switch and a
+        departed member's stored payloads stay servable for old blocks.
+      * `front_address` — genesis only: the client-facing port is the
+        node's own config, never dialed by peers.
+
+    Duck-type compatible with MempoolCommittee everywhere the mempool
+    core/synchronizer consult a committee."""
+
+    __slots__ = ("genesis", "epochs", "_known", "_known_epoch")
+
+    def __init__(self, genesis: MempoolCommittee, epochs) -> None:
+        self.genesis = genesis
+        self.epochs = epochs
+        epochs.seed_mempool_addresses(
+            {
+                pk: a.mempool_address
+                for pk, a in genesis.authorities.items()
+            }
+        )
+        # Cached union of every known epoch's member keys: `exists` runs
+        # on the per-payload gossip-ingress hot path, and rescanning the
+        # schedule per call would grow linearly with deployment age.
+        # Rebuilt lazily when the applied epoch advances.
+        self._known: frozenset = frozenset(genesis.authorities)
+        self._known_epoch = epochs.applied_epoch
+
+    @property
+    def epoch(self) -> int:
+        return self.epochs.applied_epoch
+
+    def exists(self, name: PublicKey) -> bool:
+        if name in self.genesis.authorities:
+            return True
+        if self.epochs.applied_epoch != self._known_epoch:
+            known = set(self.genesis.authorities)
+            for _activation, committee in self.epochs.schedule.entries():
+                known.update(committee.authorities)
+            self._known = frozenset(known)
+            self._known_epoch = self.epochs.applied_epoch
+        return name in self._known
+
+    def front_address(self, name: PublicKey) -> Address | None:
+        return self.genesis.front_address(name)
+
+    def mempool_address(self, name: PublicKey) -> Address | None:
+        addr = self.epochs.mempool_address(name)
+        if addr is not None:
+            return addr
+        return self.genesis.mempool_address(name)
+
+    def members_for_round(self, round_) -> tuple[PublicKey, ...]:
+        """The payload-plane membership governing `round_` — by
+        construction the consensus committee of the same round, which is
+        the 'both planes switch at the same position' pin."""
+        return tuple(self.epochs.committee_for_round(round_).sorted_keys())
+
+    def broadcast_addresses(self, myself: PublicKey) -> list[Address]:
+        out = []
+        for pk in self.epochs.current().sorted_keys():
+            if pk == myself:
+                continue
+            addr = self.mempool_address(pk)
+            if addr is not None:
+                out.append(addr)
+        return out
+
+
 @dataclass(slots=True)
 class MempoolParameters:
     """Reference defaults (mempool/src/config.rs:15-24), plus the benchmark

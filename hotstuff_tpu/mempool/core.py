@@ -105,6 +105,13 @@ class Core:
         from ..crypto.batch_service import BatchVerificationService
 
         self.name = name
+        # MempoolCommittee (static, the pre-reconfig behaviour) or a
+        # MempoolEpochView resolving through the node's shared
+        # EpochManager: gossip fan-out (broadcast_addresses) follows the
+        # CURRENT epoch's committee — a joiner starts receiving payload
+        # gossip at the activation boundary, a leaver stops at it —
+        # while acceptance (exists) and serving (mempool_address) span
+        # the known epochs so boundary-adjacent payloads stay available.
         self.committee = committee
         self.parameters = parameters
         self.store = store

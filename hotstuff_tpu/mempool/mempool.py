@@ -11,7 +11,7 @@ from ..crypto import PublicKey, SignatureService
 from ..network import NetReceiver, NetSender
 from ..store import Store
 from ..utils.actors import channel, spawn
-from .config import MempoolCommittee, MempoolParameters
+from .config import MempoolCommittee, MempoolEpochView, MempoolParameters
 from .core import Core
 from .front import Front
 from .messages import decode_mempool_message
@@ -32,10 +32,22 @@ class Mempool:
         consensus_mempool_channel: asyncio.Queue,
         consensus_channel: asyncio.Queue,
         verification_service=None,
+        epoch_manager=None,
+        listen_addresses: tuple = None,
     ) -> Core:
         """Boot the mempool plane. `consensus_mempool_channel` carries
         Get/Verify/Cleanup requests FROM consensus; `consensus_channel` lets
-        the payload synchronizer LoopBack blocks INTO the consensus core."""
+        the payload synchronizer LoopBack blocks INTO the consensus core.
+
+        `epoch_manager` (consensus/reconfig.py) is the node's SHARED
+        epoch view: when given, the committee the core/synchronizer
+        consult becomes a MempoolEpochView, so payload gossip fan-out,
+        sync serving/requesting and address resolution cross a committed
+        epoch boundary at the same activation round as consensus — the
+        payload-plane half of the epoch-final handoff (§5.5j).
+        `listen_addresses` = (front, mempool) covers a JOIN candidate
+        not present in the genesis mempool committee: it still needs
+        bound ports to serve and fetch payloads once admitted."""
         parameters.log(log)
 
         core_channel = channel()
@@ -47,7 +59,22 @@ class Mempool:
 
         front_addr = committee.front_address(name)
         mempool_addr = committee.mempool_address(name)
-        assert front_addr is not None and mempool_addr is not None
+        if listen_addresses:
+            # Fill only what the genesis committee does not provide — a
+            # committee member with an explicit listen override is more
+            # likely a misconfiguration than an intent to rebind.
+            # (Programmatic seam for join candidates, mirroring
+            # Consensus.run's listen_address; node/main.py CLI wiring
+            # for live joins is named ROADMAP residue.)
+            if front_addr is None:
+                front_addr = listen_addresses[0]
+            if mempool_addr is None:
+                mempool_addr = listen_addresses[1]
+        assert front_addr is not None and mempool_addr is not None, (
+            "node must be in the mempool committee or supply listen_addresses"
+        )
+        if epoch_manager is not None:
+            committee = MempoolEpochView(committee, epoch_manager)
 
         Front(("0.0.0.0", front_addr[1]), tx_client)
         NetReceiver(

@@ -22,7 +22,6 @@ from ..store import Store
 from ..utils.actors import spawn
 from ..consensus.messages import Block, LoopBack
 from ..consensus.mempool_driver import PayloadStatus
-from .config import MempoolCommittee
 from .messages import PayloadRequest, encode_mempool_message
 
 log = logging.getLogger("hotstuff.mempool")
@@ -34,7 +33,7 @@ class Synchronizer:
     def __init__(
         self,
         name: PublicKey,
-        committee: MempoolCommittee,
+        committee,  # MempoolCommittee | MempoolEpochView (epoch-aware)
         store: Store,
         network_tx: asyncio.Queue,
         consensus_channel: asyncio.Queue,
@@ -89,6 +88,10 @@ class Synchronizer:
     ) -> None:
         data = encode_mempool_message(PayloadRequest(digests, self.name))
         if authors is None:  # retry path: broadcast
+            # Epoch-aware: the CURRENT committee (a MempoolEpochView
+            # resolves it through the shared EpochManager) — after a
+            # boundary, retries reach the members who actually hold the
+            # successor epoch's payloads.
             addrs = self.committee.broadcast_addresses(self.name)
         else:
             addrs = [

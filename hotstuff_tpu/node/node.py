@@ -73,6 +73,10 @@ class Node:
             consensus_mempool_channel,
             consensus_core_channel,
             verification_service=verification_service,
+            # The SAME epoch view consensus applies committed changes to:
+            # payload gossip fan-out, sync and address resolution cross
+            # an epoch boundary at the same activation round (§5.5j).
+            epoch_manager=self.epoch_manager,
         )
         Consensus.run(
             name,

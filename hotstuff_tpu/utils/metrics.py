@@ -576,6 +576,16 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("reconfig.rejected", "counter", None),
     ("reconfig.late_applies", "counter", None),
     ("reconfig.epoch", "gauge", None),
+    # consensus/reconfig.py + core.py — the epoch-final handoff (§5.5j):
+    # wall-withheld certification acts, dead-fork pending drops, the
+    # boundary-edge QC commit unlock, and the per-handoff lag histogram
+    # (rounds the commit trigger landed past activation-1 — 0 on every
+    # healthy handoff, >=1 exactly on a contract violation, which is
+    # what the reconfig.handoff telemetry SLO keys on).
+    ("reconfig.handoff_holds", "counter", None),
+    ("reconfig.handoff_abandoned", "counter", None),
+    ("reconfig.handoff_commits", "counter", None),
+    ("reconfig.handoff_lag_rounds", "histogram", (0.5, 2.0, 8.0, 32.0)),
     # consensus/overlay.py — region-aware aggregation overlay (§5.5l).
     # vote_frames/timeout_frames count plane frames in BOTH modes (bundle
     # and legacy), so the timeout_storm matrix cells' frames-per-timeout

@@ -140,6 +140,20 @@ def default_slos() -> tuple[SLOSpec, ...]:
             objective=0.99,
         )
     )
+    # Epoch-final handoff contract (consensus/reconfig.py §5.5j). The
+    # histogram's unit is ROUNDS, not seconds: every healthy handoff
+    # records lag 0 (bucket lower edge 0 < threshold — never burns), a
+    # violated handoff records >= 1 (lower edge 0.5 > threshold — burns
+    # immediately), so a delayed-commit handoff fires the slo_burn
+    # alert + auto-dump instead of only logging.
+    slos.append(
+        SLOSpec(
+            name="reconfig.handoff",
+            metric="reconfig.handoff_lag_rounds",
+            threshold_s=0.4,
+            objective=0.99,
+        )
+    )
     return tuple(slos)
 
 
@@ -153,6 +167,7 @@ _DEFAULT_PREFIXES = (
     "ingress.",
     "mempool.",
     "net.",
+    "reconfig.",
     "scheduler.",
     "telemetry.",
     "timeline.",

@@ -603,6 +603,25 @@ class AnomalyWatchdog:
             burn_long=round(burn_long, 3),
         )
 
+    def note_handoff_violation(
+        self, epoch: int, activation_round: int, trigger_round: int
+    ) -> None:
+        """An epoch-final handoff contract violation from the epoch
+        manager (consensus/reconfig.py): a committed EpochChange's
+        2-chain completion landed at/past its declared activation round,
+        so gap rounds were certified by the old committee. Under the
+        certification wall this requires a Byzantine quorum or a broken
+        wall — fire the `handoff_violation` reason (recorder event +
+        auto-dump hooks) so the run is diagnosed, not just counted."""
+        if not _enabled:
+            return
+        self._trigger(
+            "handoff_violation",
+            epoch=epoch,
+            activation_round=activation_round,
+            trigger_round=trigger_round,
+        )
+
     def note_verify(self, dur_s: float, n: int) -> None:
         if not _enabled or n <= 0:
             return

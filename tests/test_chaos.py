@@ -86,6 +86,12 @@ _FAST = [
         "epoch_reconfig",  # dedicated reconfig/catch-up tests below
         "genesis_catchup",
         "long_offline_catchup",
+        # dedicated churn tests below, run under the trusted-crypto stub
+        # (membership/topology scenarios — the PR 12 trust model; exact
+        # pysigner would dominate tier-1 wall time here)
+        "rolling_churn",
+        "boundary_quorum_crash",
+        "multi_epoch_catchup",
     )
 ]
 
@@ -363,6 +369,101 @@ def test_catchup_scenarios_deterministic():
     assert a["fault_trace"] == b["fault_trace"]
     assert a["commits"] == b["commits"]
     assert a["events"] == b["events"]
+
+
+# --- production-grade succession (ISSUE 15 / ROADMAP item 4) ----------------
+# All churn tests run under the trusted-crypto stub: membership, topology
+# and timing are the properties under test (the PR 12 trust model), and
+# the stub keeps three multi-epoch scenarios inside the tier-1 budget.
+
+
+def test_rolling_churn_fully_rotates_the_committee():
+    """The tentpole acceptance row: the committee fully rotates over
+    three committed epoch boundaries under traffic — every genesis
+    member departs, every joiner range-syncs across the prior
+    boundaries and commits past the last one, per-epoch boundaries and
+    memberships are unanimous, safety/liveness stay clean, and
+    `reconfig.late_applies` is ZERO with the epoch-final handoff in
+    force."""
+    report = run_scenario("rolling_churn", seed=11, trusted_crypto=True)
+    assert report["ok"], report
+    assert report["safety_violations"] == []
+    assert report["liveness_violations"] == []
+    assert report.get("expectation_failures", []) == []
+    assert report["metrics"].get("reconfig.late_applies", 0) == 0
+    # genesis {0,1,2} fully rotated out; the fleet ends on epoch 4
+    finals = report["final_epochs"]
+    assert max(finals.values()) == 1 + 3
+    last = max(
+        (e for evs in report["epoch_switches"].values() for e in evs),
+        key=lambda e: e["epoch"],
+    )
+    assert set(last["members"]).isdisjoint({0, 1, 2})
+    # every joiner demonstrably range-synced (three admissions)
+    assert report["metrics"]["sync.range_requests"] >= 3
+
+
+def test_rolling_churn_replays_bit_identically():
+    """Acceptance: same seed => identical fault trace, commit sequences,
+    AND epoch-switch events. Truncated duration bounds the wall cost —
+    the first rotation (directive, carrier, handoff, switch, joiner
+    catch-up) lands inside the window."""
+    a = run_scenario("rolling_churn", seed=42, duration=9.0, trusted_crypto=True)
+    b = run_scenario("rolling_churn", seed=42, duration=9.0, trusted_crypto=True)
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["commits"] == b["commits"]
+    assert a["events"] == b["events"]
+    assert a["epoch_switches"] == b["epoch_switches"]
+    assert any(e["event"] == "epoch_switch" for e in a["events"])
+
+
+def test_boundary_quorum_crash_recovers_epoch_state():
+    """Quorum-crash-at-the-activation-boundary: nodes 0-2 die the
+    instant the first epoch-2 switch lands, restart against their
+    persisted stores, reload the epoch-final state (some applied, some
+    still pending), and the fleet commits past the boundary with zero
+    late applies and no safety damage."""
+    report = run_scenario("boundary_quorum_crash", seed=11, trusted_crypto=True)
+    assert report["ok"], report
+    assert report["safety_violations"] == []
+    assert report.get("expectation_failures", []) == []
+    assert report["metrics"]["chaos.crashes"] >= 3
+    assert report["metrics"]["chaos.restarts"] >= 3
+    assert report["metrics"].get("reconfig.late_applies", 0) == 0
+    for i in ("0", "1", "2", "4"):
+        assert report["final_epochs"][i] == 2
+
+
+def test_multi_epoch_catchup_crosses_boundaries_mid_batch():
+    """A joiner admitted by the SECOND of two chained changes late-boots
+    with an empty store after both boundaries committed: one genesis
+    range sync replays the chain through both epoch switches (committed
+    mid-batch, governing the blocks after them) and the node ends on
+    the live epoch near the tip."""
+    report = run_scenario("multi_epoch_catchup", seed=11, trusted_crypto=True)
+    assert report["ok"], report
+    assert report.get("expectation_failures", []) == []
+    assert report["final_epochs"]["5"] == 3
+    assert report["metrics"]["sync.range_requests"] >= 1
+    assert report["metrics"]["sync.range_blocks"] >= 3
+    # the joiner committed the same chain the quorum committed
+    joined = set(map(tuple, report["commits"]["5"]))
+    quorum = {
+        (r, d)
+        for i in ("2", "3", "4")
+        for r, d in map(tuple, report["commits"][i])
+    }
+    assert joined and joined <= quorum
+
+
+@pytest.mark.slow
+def test_rolling_churn_exact_crypto_soak():
+    """The exact-pysigner churn variant (the matrix carries it at n=4;
+    this is the full-size n=6 soak): identical contract, real RFC 8032
+    signatures end to end."""
+    report = run_scenario("rolling_churn", seed=11)
+    assert report["ok"], report
+    assert report["metrics"].get("reconfig.late_applies", 0) == 0
 
 
 @pytest.mark.slow

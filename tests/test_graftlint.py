@@ -380,6 +380,46 @@ def test_wire_schema_prefix_shadowing_and_clean_codec(tmp_path):
     assert _run(clean, select=["wire-schema"]).findings == []
 
 
+def test_wire_schema_store_key_collision_and_prefix(tmp_path):
+    """Persisted-state key spaces (`*_KEY` / `*_PREFIX` bytes constants)
+    must be unique and prefix-free across modules: a collision would
+    silently alias one subsystem's store blob as another's (ISSUE 15
+    grew the epoch-state blob — this keeps such growth collision-free)."""
+    _write(
+        tmp_path,
+        "hotstuff_tpu/one.py",
+        '_STATE_KEY = b"epoch-state"\n',
+    )
+    _write(
+        tmp_path,
+        "hotstuff_tpu/two.py",
+        '_OTHER_KEY = b"epoch-state"\n'
+        'PAYLOAD_PREFIX = b"epoch-state:extra"\n',
+    )
+    result = _run(tmp_path, select=["wire-schema"])
+    msgs = [f.message for f in result.findings]
+    assert any(
+        "store key space" in m and "more than one module" in m for m in msgs
+    )
+    assert any(
+        "store key space" in m and "proper prefix" in m for m in msgs
+    )
+
+    clean = tmp_path / "clean"
+    _write(
+        clean,
+        "hotstuff_tpu/a.py",
+        '_SAFETY_KEY = b"safety-state"\n',
+    )
+    _write(
+        clean,
+        "hotstuff_tpu/b.py",
+        '_EPOCH_KEY = b"epoch-state"\n'
+        'PAYLOAD_PREFIX = b"payload:"\n',
+    )
+    assert _run(clean, select=["wire-schema"]).findings == []
+
+
 # ---------------------------------------------------------------------------
 # suppression layers: pragma + baseline
 

@@ -1,0 +1,355 @@
+"""Epoch-final handoff units (consensus/reconfig.py §5.5j) — dependency-
+free (pysigner, no `cryptography`, no jax): pending-carrier tracking and
+the certification wall, dead-fork abandonment, persistence of the
+epoch-final state across a crash landing exactly at the activation
+boundary, the extended EpochChange wire format (payload-plane member
+addresses), the handoff-violation watchdog reason, and the
+MempoolEpochView — the payload plane's half of the handoff, pinned to
+switch at the SAME position as consensus.
+"""
+
+import pytest
+
+from hotstuff_tpu.consensus.config import Committee
+from hotstuff_tpu.consensus.reconfig import (
+    EpochChange,
+    EpochManager,
+)
+from hotstuff_tpu.crypto import pysigner
+from hotstuff_tpu.crypto.primitives import PublicKey, Signature
+from hotstuff_tpu.mempool.config import MempoolCommittee, MempoolEpochView
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils import tracing
+from hotstuff_tpu.utils.serde import Reader, Writer
+
+
+def _keys(n: int = 6):
+    pairs = sorted(
+        pysigner.keypair_from_seed(bytes([i + 1]) * 32) for i in range(n)
+    )
+    return [(PublicKey(pk), seed) for pk, seed in pairs]
+
+
+def _committee(keys, indices, epoch: int = 1) -> Committee:
+    return Committee.new(
+        [(keys[i][0], 1, ("127.0.0.1", 9_000 + i)) for i in indices],
+        epoch=epoch,
+    )
+
+
+def _change(keys, indices, new_epoch=2, activation=20, signer=0) -> EpochChange:
+    members = [
+        (
+            keys[i][0],
+            1,
+            ("127.0.0.1", 9_000 + i),
+            ("127.0.0.1", 9_500 + i),  # payload-plane port rides the wire
+        )
+        for i in indices
+    ]
+    pk, seed = keys[signer]
+    return EpochChange.new_from_seed(new_epoch, activation, members, pk, seed)
+
+
+# --- wire format: payload-plane member addresses -----------------------------
+
+
+def test_epoch_change_wire_carries_mempool_addresses():
+    keys = _keys()
+    change = _change(keys, [0, 1, 2, 4])
+    w = Writer()
+    change.encode(w)
+    again = EpochChange.decode(Reader(w.bytes()))
+    assert again == change
+    assert again.mempool_addresses() == {
+        keys[i][0]: ("127.0.0.1", 9_500 + i) for i in (0, 1, 2, 4)
+    }
+    # the digest commits to the payload-plane address too: desynchronizing
+    # the two planes would require breaking the author's signature
+    moved = tuple(
+        (pk, stake, addr, (maddr[0], maddr[1] + 1))
+        for pk, stake, addr, maddr in change.members
+    )
+    tampered = EpochChange(
+        change.new_epoch, change.activation_round, moved,
+        change.author, change.signature,
+    )
+    assert tampered.digest() != change.digest()
+
+
+def test_epoch_change_triples_normalize_to_shared_address():
+    """Single-plane callers (and the PR 10 test corpus) pass (key, stake,
+    address) triples: the mempool address mirrors the consensus one."""
+    keys = _keys()
+    pk, seed = keys[0]
+    change = EpochChange.new_from_seed(
+        2, 20, [(keys[i][0], 1, ("127.0.0.1", 9_000 + i)) for i in (0, 1)],
+        pk, seed,
+    )
+    assert all(m[3] == m[2] for m in change.members)
+    w = Writer()
+    change.encode(w)
+    assert EpochChange.decode(Reader(w.bytes())) == change
+
+
+# --- pending handoffs & the certification wall -------------------------------
+
+
+def test_pending_handoff_arms_and_apply_clears_the_wall(run_async):
+    async def body():
+        keys = _keys()
+        mgr = EpochManager(_committee(keys, [0, 1, 2, 3]), register_backend=False)
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert not mgr.handoff_pending()
+        assert await mgr.note_pending(change, carrier_round=9)
+        assert not await mgr.note_pending(change, carrier_round=9)  # idempotent
+        assert await mgr.note_pending(change, carrier_round=10)  # 2nd carrier
+        assert mgr.handoff_pending()
+        assert mgr.handoff_boundary() == 15
+        # the wall covers the boundary and everything past it, nothing below
+        assert not mgr.handoff_blocks(14)
+        assert mgr.handoff_blocks(15) and mgr.handoff_blocks(40)
+        # commit = apply: wall comes down, schedule switches at the boundary
+        assert await mgr.apply(change, trigger_round=12)
+        assert not mgr.handoff_pending()
+        assert not mgr.handoff_blocks(15)
+        assert mgr.committee_for_round(15).epoch == 2
+
+    run_async(body())
+
+
+def test_dead_fork_pending_is_abandoned(run_async):
+    async def body():
+        keys = _keys()
+        mgr = EpochManager(_committee(keys, [0, 1, 2, 3]), register_backend=False)
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        await mgr.note_pending(change, carrier_round=9)
+        # chain commits up to the carrier round WITHOUT the change
+        # applying: the carrier fork died, its boundary must stop walling
+        await mgr.note_commit(8)
+        assert mgr.handoff_pending()  # carrier round not passed yet
+        await mgr.note_commit(9)
+        assert not mgr.handoff_pending()
+        assert not mgr.handoff_blocks(15)
+
+    run_async(body())
+
+
+def test_stale_pending_for_applied_epoch_is_ignored(run_async):
+    async def body():
+        keys = _keys()
+        mgr = EpochManager(_committee(keys, [0, 1, 2, 3]), register_backend=False)
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert await mgr.apply(change)
+        # a late-arriving carrier for the already-applied epoch is stale
+        assert not await mgr.note_pending(change, carrier_round=9)
+        assert not mgr.handoff_pending()
+
+    run_async(body())
+
+
+# --- persistence: crash landing exactly at the activation boundary -----------
+
+
+def test_epoch_final_state_survives_a_boundary_crash(run_async):
+    """The satellite pin: a node crashing BETWEEN admitting a carrier and
+    committing it must wake with the wall intact, and a node crashing
+    right after the apply must wake with the identical round->committee
+    map — it may never re-judge (or help re-certify) gap rounds."""
+
+    async def body():
+        keys = _keys()
+        genesis = _committee(keys, [0, 1, 2, 3])
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        store = Store()
+
+        # incarnation 1: admits the carrier (wall up), then "crashes"
+        mgr = EpochManager(genesis, register_backend=False)
+        await mgr.note_pending(change, carrier_round=9, store=store)
+        assert mgr.handoff_blocks(15)
+
+        # incarnation 2: reload — the wall is intact before any traffic
+        again = EpochManager(genesis, register_backend=False)
+        await again.load(store)
+        assert again.handoff_pending()
+        assert again.handoff_boundary() == 15
+        assert again.handoff_blocks(15)
+        assert not again.handoff_blocks(14)
+
+        # the commit lands; crash AGAIN right at the switch
+        assert await again.apply(change, store=store, trigger_round=12)
+
+        # incarnation 3: the epoch-final state reloads — same schedule,
+        # wall down, and no gap round is ever re-judged differently
+        final = EpochManager(genesis, register_backend=False)
+        await final.load(store)
+        assert final.applied_epoch == 2
+        assert not final.handoff_pending()
+        for r in range(1, 30):
+            assert (
+                final.committee_for_round(r).epoch
+                == again.committee_for_round(r).epoch
+            )
+        # payload-plane registry survives too (the joiner stays fetchable)
+        assert final.mempool_address(keys[4][0]) == ("127.0.0.1", 9_504)
+
+    run_async(body())
+
+
+def test_legacy_entries_only_epoch_state_still_loads(run_async):
+    """Pre-handoff persistence was a bare entries list; a store written
+    by the old format must still reload (upgrade path)."""
+    import json
+
+    async def body():
+        keys = _keys()
+        genesis = _committee(keys, [0, 1, 2, 3])
+        e2 = _committee(keys, [0, 1, 2, 4], epoch=2)
+        store = Store()
+        await store.write(
+            b"epoch-state",
+            json.dumps(
+                [{"activation_round": 15, "committee": e2.to_json()}]
+            ).encode(),
+        )
+        mgr = EpochManager(genesis, register_backend=False)
+        await mgr.load(store)
+        assert mgr.applied_epoch == 2
+        assert mgr.committee_for_round(15).epoch == 2
+
+    run_async(body())
+
+
+# --- the hard invariant: late applies fire the watchdog ----------------------
+
+
+def test_late_apply_is_a_violation_and_fires_the_watchdog(run_async):
+    async def body():
+        from hotstuff_tpu.utils import metrics
+
+        late = metrics.counter("reconfig.late_applies")
+        fired = []
+        hook = lambda reason, detail: fired.append((reason, detail))
+        tracing.WATCHDOG.add_dump_hook(hook)
+        # The process-global watchdog applies a per-reason cooldown; an
+        # earlier test (or chaos scenario) may have consumed it.
+        tracing.WATCHDOG._last_fired.pop("handoff_violation", None)
+        try:
+            keys = _keys()
+            change = _change(keys, [0, 1, 2, 4], activation=15)
+            # healthy handoff: slack >= 1, nothing fires
+            mgr = EpochManager(
+                _committee(keys, [0, 1, 2, 3]), register_backend=False
+            )
+            c0 = late.value
+            assert await mgr.apply(change, trigger_round=14)
+            assert late.value == c0
+            assert fired == []
+            # violated handoff: counted AND escalated through the watchdog
+            bad = EpochManager(
+                _committee(keys, [0, 1, 2, 3]), register_backend=False
+            )
+            assert await bad.apply(change, trigger_round=15)
+            assert late.value == c0 + 1
+            if tracing.enabled():
+                assert [r for r, _d in fired] == ["handoff_violation"]
+                assert fired[0][1]["trigger_round"] == 15
+            # the SCHEDULE stays the declared boundary on both (pure
+            # chain content — determinism before everything)
+            assert bad.schedule.entries() == mgr.schedule.entries()
+        finally:
+            tracing.WATCHDOG.remove_dump_hook(hook)
+
+    run_async(body())
+
+
+# --- MempoolEpochView: the payload plane crosses at the same position --------
+
+
+def _mempool_committee(keys, indices) -> MempoolCommittee:
+    return MempoolCommittee.new(
+        [
+            (keys[i][0], ("127.0.0.1", 9_200 + i), ("127.0.0.1", 9_500 + i))
+            for i in indices
+        ]
+    )
+
+
+def test_mempool_view_switches_at_the_consensus_position(run_async):
+    """The pin the ISSUE names: the mempool committee view and the
+    consensus committee view switch at the SAME position (the declared
+    activation round) — one shared schedule, two planes."""
+
+    async def body():
+        keys = _keys()
+        mgr = EpochManager(_committee(keys, [0, 1, 2, 3]), register_backend=False)
+        view = MempoolEpochView(_mempool_committee(keys, [0, 1, 2, 3]), mgr)
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert await mgr.apply(change)
+        for r in (1, 14, 15, 16, 40):
+            consensus_members = tuple(mgr.committee_for_round(r).sorted_keys())
+            assert view.members_for_round(r) == consensus_members
+        # the boundary is exactly round 15 on BOTH planes
+        assert keys[3][0] in view.members_for_round(14)
+        assert keys[3][0] not in view.members_for_round(15)
+        assert keys[4][0] not in view.members_for_round(14)
+        assert keys[4][0] in view.members_for_round(15)
+
+    run_async(body())
+
+
+def test_joiner_payloads_fetchable_and_leaver_unsubscribed(run_async):
+    """The acceptance pin: after the switch, gossip fan-out covers the
+    JOINER (its payloads become fetchable — peers can resolve its
+    mempool port from the chain-carried change) and drops the LEAVER
+    (it stops receiving payload gossip), while the leaver's own stored
+    payloads stay servable for old blocks."""
+
+    async def body():
+        keys = _keys()
+        mgr = EpochManager(_committee(keys, [0, 1, 2, 3]), register_backend=False)
+        view = MempoolEpochView(_mempool_committee(keys, [0, 1, 2, 3]), mgr)
+        me = keys[0][0]
+        joiner, leaver = keys[4][0], keys[3][0]
+
+        # pre-switch: the joiner is unknown to the payload plane
+        assert view.mempool_address(joiner) is None
+        assert ("127.0.0.1", 9_504) not in view.broadcast_addresses(me)
+
+        change = _change(keys, [0, 1, 2, 4], activation=15)
+        assert await mgr.apply(change)
+
+        # pre-boundary rounds still gossip to the OLD committee
+        mgr.note_round(14)
+        assert ("127.0.0.1", 9_503) in view.broadcast_addresses(me)
+        assert ("127.0.0.1", 9_504) not in view.broadcast_addresses(me)
+
+        # at the boundary both planes flip together
+        mgr.note_round(15)
+        addrs = view.broadcast_addresses(me)
+        assert ("127.0.0.1", 9_504) in addrs  # joiner now receives gossip
+        assert ("127.0.0.1", 9_503) not in addrs  # leaver stopped
+        # the joiner's payloads are FETCHABLE: requesters resolve its port
+        assert view.mempool_address(joiner) == ("127.0.0.1", 9_504)
+        # the leaver's stored payloads stay servable for old blocks
+        assert view.mempool_address(leaver) == ("127.0.0.1", 9_503)
+        # acceptance spans both epochs near the boundary
+        assert view.exists(joiner) and view.exists(leaver)
+
+    run_async(body())
+
+
+def test_wire_member_cap_rejected():
+    from hotstuff_tpu.consensus.reconfig import MAX_WIRE_MEMBERS
+    from hotstuff_tpu.utils.serde import SerdeError
+
+    keys = _keys(1)
+    pk, seed = keys[0]
+    member = (pk, 1, ("127.0.0.1", 1), ("127.0.0.1", 2))
+    change = EpochChange(
+        2, 20, tuple([member] * (MAX_WIRE_MEMBERS + 1)), pk, Signature(bytes(64))
+    )
+    w = Writer()
+    change.encode(w)
+    with pytest.raises(SerdeError):
+        EpochChange.decode(Reader(w.bytes()))

@@ -1094,6 +1094,43 @@ def test_log_parser_reconfig_section():
     assert "2 range sync(s), worst start lag 21 rounds, 19 blocks fetched" in out
 
 
+def test_log_parser_handoff_lines_and_violation_warning():
+    """Epoch-final handoff lines (consensus/reconfig.py §5.5j) fold into
+    the '+ RECONFIG:' section — rotation count + the WORST slack (the
+    handoff that came closest to its boundary, the margin-sizing signal)
+    — and a handoff VIOLATION line raises a WARNING (the hard
+    invariant: it must normally never appear)."""
+    from benchmark.logs import LogParser
+
+    node = NODE_LOG + (
+        "[2026-07-30T10:00:03.000Z INFO hotstuff.consensus] Epoch handoff "
+        "to 2 committed at round 11 (boundary 14, slack 3 rounds)\n"
+        "[2026-07-30T10:00:07.000Z INFO hotstuff.consensus] Epoch handoff "
+        "to 3 committed at round 22 (boundary 23, slack 1 rounds)\n"
+    )
+    other = NODE_LOG + (
+        "[2026-07-30T10:00:03.100Z INFO hotstuff.consensus] Epoch handoff "
+        "to 2 committed at round 11 (boundary 14, slack 3 rounds)\n"
+    )
+    p = LogParser([CLIENT_LOG], [node, other])
+    assert sorted(p.handoffs) == [(2, 11, 14, 3), (2, 11, 14, 3), (3, 22, 23, 1)]
+    assert p.handoff_violations == 0
+    out = p.result()
+    assert "Handoffs: 3 across 2 rotation(s), worst slack 1 round(s)" in out
+    assert "handoff VIOLATION" not in out
+
+    bad = NODE_LOG + (
+        "[2026-07-30T10:00:09.000Z WARN hotstuff.consensus] Epoch handoff "
+        "VIOLATION: epoch 2 commit landed at round 16, at/past the "
+        "declared activation round 15 — gap rounds were certified by the "
+        "old committee (the epoch-final wall should have made this "
+        "impossible)\n"
+    )
+    p2 = LogParser([CLIENT_LOG], [bad])
+    assert p2.handoff_violations == 1
+    assert "WARNING: 1 epoch handoff VIOLATION(s)" in p2.result()
+
+
 # ---------------------------------------------------------------------------
 # Scenario-matrix runner (tools/chaos_run.py --matrix) + the LogParser
 # MATRIX section (benchmark/logs.py) + the matrix-grid lint

@@ -230,10 +230,17 @@ def lint_scenarios(tests_dir: str | None = None) -> list[str]:
 
 def lint_matrix() -> list[str]:
     """Every matrix-grid scenario must resolve in the registry and be
-    committee-size-invariant (no pinned committee subset) — the grid is
-    the regression harness for every scale claim, so a silently-dropped
-    cell is a silently-dropped guarantee."""
-    from hotstuff_tpu.chaos.scenarios import MATRIX_SCENARIOS, SCENARIOS
+    committee-size-invariant — the grid is the regression harness for
+    every scale claim, so a silently-dropped cell is a silently-dropped
+    guarantee. A pinned `committee=` subset is banned; the
+    size-parameterized `committee_n=` form (reconfig cells) is allowed
+    but must yield a valid PROPER subset at every grid size (the
+    rotation machinery needs join candidates outside the committee)."""
+    from hotstuff_tpu.chaos.scenarios import (
+        MATRIX_SCENARIOS,
+        MATRIX_SIZES,
+        SCENARIOS,
+    )
 
     problems: list[str] = []
     for name in MATRIX_SCENARIOS:
@@ -244,12 +251,27 @@ def lint_matrix() -> list[str]:
                 "chaos scenario registry (chaos_run.py --matrix would "
                 "reject the default grid)"
             )
-        elif scenario.committee is not None:
+            continue
+        if scenario.committee is not None:
             problems.append(
                 f"matrix-grid scenario {name!r} pins committee indices "
                 f"{scenario.committee} — grid cells override the "
                 "committee size, which a pinned subset cannot survive"
             )
+        if scenario.committee_n is not None:
+            for n in MATRIX_SIZES:
+                indices = scenario.committee_n(n)
+                if not indices or any(i < 0 or i >= n for i in indices):
+                    problems.append(
+                        f"matrix-grid scenario {name!r}: committee_n({n}) "
+                        f"= {indices} is not a valid node subset"
+                    )
+                elif scenario.reconfig_n is not None and len(indices) >= n:
+                    problems.append(
+                        f"matrix-grid scenario {name!r}: committee_n({n}) "
+                        "covers every node — a rotation directive has no "
+                        "join candidates to admit"
+                    )
     return problems
 
 

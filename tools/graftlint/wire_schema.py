@@ -30,6 +30,16 @@ Two invariants nothing at runtime re-checks:
     Appending a tagged section INSIDE an existing preimage
     (`h += b"HSEPOCH" + ...`) is not a claim — interior markers share
     the enclosing domain on purpose.
+
+  * Store keys. Persisted state blobs share ONE key-value store per
+    node (consensus safety state, the epoch-final handoff state,
+    payload bytes, block digests). Every module declares its key space
+    as a module-level `*_KEY = b"..."` / `*_PREFIX = b"..."` bytes
+    constant; two modules claiming the same (or prefix-overlapping)
+    key space would silently alias each other's persisted state — a
+    restart would then reload one subsystem's bytes as another's
+    (the epoch-state blob grew a pending-handoff section in ISSUE 15;
+    this is the check that keeps such growth collision-free).
 """
 
 from __future__ import annotations
@@ -40,6 +50,7 @@ import re
 from .core import Context, Finding, Source, register
 
 _TAG_NAME = re.compile(r"^TAG_[A-Z0-9_]+$")
+_STORE_KEY_NAME = re.compile(r"(_KEY|_PREFIX)$")
 _DOMAIN_LITERAL = re.compile(rb"^HS[A-Z0-9]+$")
 _DOMAIN_CONST = re.compile(r"DOMAIN")
 _DIGEST_FNS = {"sha512_32", "sha512", "sha256", "blake2b"}
@@ -136,18 +147,84 @@ def _check_tags(src: Source, findings: list[Finding]) -> None:
                     seen.setdefault(value, (tgt.id, node.lineno))
 
 
+def _collect_store_keys(
+    src: Source, keys: list[tuple[bytes, str, int, str]]
+) -> None:
+    """Module-level `NAME_KEY = b"..."` / `NAME_PREFIX = b"..."` bytes
+    constants: the declared store key spaces."""
+    tree = src.tree
+    assert tree is not None
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if (
+                isinstance(tgt, ast.Name)
+                and _STORE_KEY_NAME.search(tgt.id)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, bytes)
+            ):
+                keys.append((node.value.value, src.rel, node.lineno, tgt.id))
+
+
+def _check_store_keys(
+    keys: list[tuple[bytes, str, int, str]], findings: list[Finding]
+) -> None:
+    """Cross-module uniqueness + prefix-freedom over the declared store
+    key spaces (duplicates within one file are that module's business)."""
+    by_key: dict[bytes, dict[str, tuple[int, str]]] = {}
+    for key, path, line, name in sorted(keys, key=lambda k: (k[0], k[1], k[2])):
+        by_key.setdefault(key, {}).setdefault(path, (line, name))
+    spaces = sorted(by_key)
+    for key, files in sorted(by_key.items()):
+        if len(files) > 1:
+            where = ", ".join(
+                f"{p}:{line} ({name})" for p, (line, name) in sorted(files.items())
+            )
+            for path, (line, _name) in sorted(files.items()):
+                findings.append(
+                    Finding(
+                        path,
+                        line,
+                        "wire-schema",
+                        f"store key space {key!r} is claimed by more than "
+                        f"one module ({where}) — persisted state would "
+                        "alias across subsystems",
+                    )
+                )
+    for i, a in enumerate(spaces):
+        for b in spaces[i + 1 :]:
+            if b.startswith(a) and a != b:
+                pa = sorted(by_key[a].items())[0]
+                pb = sorted(by_key[b].items())[0]
+                findings.append(
+                    Finding(
+                        pa[0],
+                        pa[1][0],
+                        "wire-schema",
+                        f"store key space {a!r} is a proper prefix of "
+                        f"{b!r} (declared at {pb[0]}:{pb[1][0]}) — one "
+                        "subsystem's reads would match the other's keys",
+                    )
+                )
+
+
 @register(
     "wire-schema",
-    "frame-tag uniqueness per codec module, digest-domain uniqueness repo-wide",
+    "frame-tag uniqueness per codec module, digest-domain + store-key "
+    "uniqueness repo-wide",
 )
 def run(ctx: Context) -> list[Finding]:
     findings: list[Finding] = []
     claims: list[tuple[bytes, str, int, str]] = []
+    store_keys: list[tuple[bytes, str, int, str]] = []
     for src in ctx.sources_under("hotstuff_tpu/"):
         if src.tree is None:
             continue
         _check_tags(src, findings)
         _collect_claims(src, claims)
+        _collect_store_keys(src, store_keys)
+    _check_store_keys(store_keys, findings)
     # Cross-module duplicate claims: the same leading prefix declared in
     # two files is two artifact kinds sharing a preimage space. Repeats
     # WITHIN a file are fine (a codec recomputes its own domain freely).
