@@ -198,6 +198,27 @@ def _parse_node(text: str) -> dict:
             r"Agg fallback round (\d+): (\d+) entries to (\d+) peers", text
         )
     ]
+    # Network-observatory lines (consensus/core.py _log_peer_map): the
+    # periodic per-vantage RTT map and cumulative probe counters. Both
+    # are cumulative/monotone per node, so the LAST line wins — except
+    # the worst EWMA, which keeps the max ever logged (a link that
+    # degraded mid-run and recovered still counts as the worst seen).
+    rtt_maps = _search_all(
+        r"Peer RTT map: (\d+) peer\(s\) in (\d+) class\(es\), "
+        r"worst EWMA ([\d.]+) ms",
+        text,
+    )
+    out["peer_rtt"] = (
+        (
+            int(rtt_maps[-1][0]),
+            int(rtt_maps[-1][1]),
+            max(float(w) for _p, _c, w in rtt_maps),
+        )
+        if rtt_maps
+        else None
+    )
+    probes = _search_all(r"Probe summary: (\d+) sent, (\d+) answered", text)
+    out["probes"] = (int(probes[-1][0]), int(probes[-1][1])) if probes else None
     # Scenario-matrix result lines (tools/chaos_run.py --matrix): per-cell
     # verdicts, green->red regressions against the committed baseline
     # artifact, and the worst per-cell commit-rate delta.
@@ -329,6 +350,11 @@ class LogParser:
         # quorum and (round, entries, peers) per gossip fallback.
         self.agg_quorums: list[tuple[str, int, int]] = []
         self.agg_fallbacks: list[tuple[int, int, int]] = []
+        # Network-observatory scrapes: (peers, classes, worst EWMA ms) per
+        # node that logged an RTT map, plus fleet probe send/answer totals.
+        self.peer_rtts: list[tuple[int, int, float]] = []
+        self.probes_sent = 0
+        self.probes_answered = 0
         # Scenario-matrix lines: (cell, green|red) verdicts, newly-red
         # cell names, and (cell, pct) worst commit-rate deltas.
         self.matrix_cells: list[tuple[str, str]] = []
@@ -370,6 +396,11 @@ class LogParser:
             self.range_blocks += r.get("range_blocks", 0)
             self.agg_quorums.extend(r.get("agg_quorums", []))
             self.agg_fallbacks.extend(r.get("agg_fallbacks", []))
+            if r.get("peer_rtt") is not None:
+                self.peer_rtts.append(r["peer_rtt"])
+            if r.get("probes") is not None:
+                self.probes_sent += r["probes"][0]
+                self.probes_answered += r["probes"][1]
             self.matrix_cells.extend(r.get("matrix_cells", []))
             self.matrix_regressions.extend(r.get("matrix_regressions", []))
             self.matrix_worst.extend(r.get("matrix_worst", []))
@@ -420,6 +451,7 @@ class LogParser:
             (r"Max payload size set to (\d+) B", "max_payload_size"),
             (r"Min block delay set to (\d+) ms", "min_block_delay"),
             (r"Queue capacity set to (\d+)", "queue_capacity"),
+            (r"Probe interval set to (\d+) ms", "probe_interval"),
         ]:
             ms = re.findall(pat, text)
             if ms:
@@ -562,6 +594,29 @@ class LogParser:
                     + "\n".join(lines)
                     + "\n"
                 )
+        network = ""
+        if self.peer_rtts or self.probes_sent:
+            network = " + NETWORK:\n"
+            if self.peer_rtts:
+                # Worst link anywhere in the fleet; the class count from
+                # the same vantage says whether that link crossed an RTT
+                # class boundary (>= 2 classes: a cross-region hop).
+                peers, classes, worst = max(
+                    self.peer_rtts, key=lambda pcw: pcw[2]
+                )
+                network += (
+                    f" Worst peer RTT EWMA: {worst:,.1f} ms"
+                    f" ({peers} peer(s) in {classes} RTT class(es)"
+                    " from that vantage)\n"
+                )
+            if self.probes_sent:
+                lost = max(0, self.probes_sent - self.probes_answered)
+                loss_pct = 100.0 * lost / self.probes_sent
+                network += (
+                    f" Probes: {self.probes_sent:,} sent,"
+                    f" {self.probes_answered:,} answered"
+                    f" ({lost:,} outstanding = {loss_pct:.1f} %)\n"
+                )
         telemetry = ""
         if self.occupancies or self.slo_fired or self.slo_cleared:
             telemetry = " + TELEMETRY:\n"
@@ -700,6 +755,7 @@ class LogParser:
                 else ""
             )
             + ingress
+            + network
             + telemetry
             + lint
             + matrix

@@ -478,6 +478,7 @@ class ChaosOrchestrator:
                 lane_stats=lambda node=node: (
                     node.service.lane_stats if node.service else None
                 ),
+                peers_fn=lambda i=i: self._peer_view(i),
                 clock=loop.time,
             )
             plane.attach_watchdog()
@@ -545,6 +546,17 @@ class ChaosOrchestrator:
         else:
             stats["completed"] += 1
             stats["verified"] += sum(bool(ok) for ok in mask)
+
+    def _peer_view(self, i: int) -> dict:
+        """Node i's per-peer observatory snapshot (network/net.py ledger)
+        re-keyed from transport addresses to node indices — the chaos
+        port map is BASE_PORT + index, so reports and telemetry dumps
+        speak node labels like every other section."""
+        out = {}
+        for key, snap in net.peer_snapshot(i).items():
+            _, _, port = key.rpartition(":")
+            out[str(int(port) - BASE_PORT)] = snap
+        return out
 
     async def _drain(self, i: int, commit_channel: asyncio.Queue) -> None:
         loop = asyncio.get_running_loop()
@@ -762,6 +774,10 @@ class ChaosOrchestrator:
         structured report."""
         prev_backend = set_backend(pysigner.PurePythonBackend())
         prev_transport = net.install_transport(self.transport)
+        # Fresh observatory ledger per run: the peer map is process-global
+        # (keyed by node label), and tier-1 runs scenarios back to back in
+        # one process — a stale link row would break same-seed bit-identity.
+        net.reset_peers()
         # Scheme install covers EVERY pysigner path for the run — node
         # signature services, backend verification, byzantine policies,
         # EpochChange construction, the SafetyChecker audit — so a run is
@@ -865,6 +881,14 @@ class ChaosOrchestrator:
             "wan_regions": {
                 str(i): region
                 for i, region in enumerate(self.transport.regions)
+            },
+            # Per-node network observatory (per-peer link counters + RTT
+            # EWMAs, node-index keyed): the canonical section scenario
+            # expectations and trace_report read — present even for
+            # telemetry-less runs. RTT rows appear only when the scenario
+            # enabled probing (Parameters.probe_interval_ms).
+            "peers": {
+                str(i): self._peer_view(i) for i in range(self.n)
             },
             "plan": self.plan.to_json(),
             "events": self.events,

@@ -20,7 +20,11 @@ from ..crypto.scheduler import SchedulerConfig
 from ..ingress.admission import IngressConfig, LaneSpec
 from ..ingress.loadgen import ArrivalCurve, IngressLoad
 from ..utils import metrics
-from ..utils.telemetry import TelemetryConfig
+from ..utils.telemetry import (
+    TelemetryConfig,
+    infer_fleet_regions,
+    peer_latency_map,
+)
 from . import vtime
 from .byzantine import (
     BundlePoisoner,
@@ -1069,6 +1073,80 @@ _register(
 )
 
 
+def _observatory_params() -> Parameters:
+    """The probe opt-in (Parameters.probe_interval_ms): probe frames
+    share the transport's per-link fault streams with protocol traffic,
+    so only the observatory scenarios — whose pins were minted WITH
+    probes on — enable them. 250 ms gives every directed link several
+    closed probe loops even on an early-stopping seed."""
+    return Parameters(
+        timeout_delay=1_000,
+        sync_retry_delay=1_000,
+        timeout_backoff=2.0,
+        max_timeout_delay=8_000,
+        probe_interval_ms=250,
+    )
+
+
+def _partition_of(regions: dict) -> set[frozenset]:
+    """Label-free form of a node->region map: the set of region member
+    sets, so synthetic `rtt-k` labels compare against seeded geography."""
+    groups: dict[str, set] = {}
+    for node, region in regions.items():
+        groups.setdefault(region, set()).add(str(node))
+    return {frozenset(g) for g in groups.values()}
+
+
+def _expect_wan_observatory(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "net.peer.probes_sent")
+    problems += _expect_counter(deltas, "net.peer.pongs_received")
+    n = report["nodes"]
+    latency = peer_latency_map(report.get("peers") or {})
+    missing = [
+        (a, b)
+        for a in (str(i) for i in range(n))
+        for b in (str(j) for j in range(n))
+        if a != b and (latency.get(a) or {}).get(b) is None
+    ]
+    if missing:
+        problems.append(
+            f"{len(missing)} directed link(s) never closed a probe loop "
+            f"(first: {missing[:3]})"
+        )
+        return problems
+    inferred = infer_fleet_regions(latency)
+    truth = report.get("wan_regions") or {}
+    if not truth:
+        return problems + ["no seeded WAN regions in the report"]
+    if _partition_of(inferred) != _partition_of(truth):
+        problems.append(
+            "measured RTT classes do not recover the seeded WAN geometry: "
+            f"inferred {sorted(inferred.items())} vs seeded "
+            f"{sorted(truth.items())}"
+        )
+    return problems
+
+
+_register(
+    Scenario(
+        name="wan_observatory",
+        description="Network observatory under the seeded 4-region WAN "
+        "matrix: RTT probes on (Parameters.probe_interval_ms), clean "
+        "links — every directed link must close probe loops, and the "
+        "measured per-peer RTT EWMAs must recover the seeded region "
+        "geometry exactly (fleet union-find under the 30 ms threshold "
+        "matches the plan's region partition). Same seed, same ledger, "
+        "bit for bit — the measurement substrate for region-aware "
+        "leader election (ROADMAP item 5).",
+        plan=lambda: FaultPlan(wan=WanMatrix()),
+        parameters=_observatory_params,
+        duration=30.0,
+        min_commits=8,
+        expect=_expect_wan_observatory,
+    )
+)
+
+
 # ---------------------------------------------------------------------------
 # Production-grade succession (ISSUE 15 / ROADMAP item 4): rolling committee
 # churn under the epoch-final handoff, quorum crashing at the activation
@@ -1367,6 +1445,10 @@ SHORT_SCENARIOS = [name for name, s in SCENARIOS.items() if not s.slow]
 # by construction (committee_n + rotation directives derive membership
 # from n), exact crypto at n=4, trusted-stub at n=64, with per-node
 # commit floors scaled by the committee geometry in its expectation.
+# wan_observatory is ISSUE 16's measurement cell: probes on, clean
+# links — asserts the MEASURED per-peer RTT classes recover the seeded
+# WanMatrix geometry at every grid size (committee-free by construction;
+# the probe plane is size-agnostic).
 MATRIX_SCENARIOS = (
     "baseline",
     "lossy_links",
@@ -1374,6 +1456,7 @@ MATRIX_SCENARIOS = (
     "timeout_storm",
     "timeout_storm_legacy",
     "rolling_churn",
+    "wan_observatory",
 )
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)

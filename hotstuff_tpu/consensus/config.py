@@ -109,6 +109,13 @@ class Parameters:
     agg_hold_ms: int = 50  # interior merge window before forwarding up
     agg_fallback_ms: int = 500  # stalled-round bound before gossip fallback
     agg_max_forwards: int = 3  # upward re-forwards per (round, kind) key
+    # Network-observatory RTT probing (network/net.py peer ledger,
+    # consensus/core.py probe ticker). 0 disables it — the default,
+    # because probe frames share the chaos transport's per-link fault
+    # streams with protocol traffic: enabling them shifts every
+    # committed same-seed determinism pin. Scenarios that measure the
+    # network (wan_observatory) opt in explicitly.
+    probe_interval_ms: int = 0
 
     def log(self, log) -> None:
         # NOTE: these log entries are parsed by the benchmark LogParser.
@@ -117,6 +124,8 @@ class Parameters:
         log.info("Max payload size set to %s B", self.max_payload_size)
         log.info("Min block delay set to %s ms", self.min_block_delay)
         log.info("Timeout backoff set to %s", self.timeout_backoff)
+        if self.probe_interval_ms:
+            log.info("Probe interval set to %s ms", self.probe_interval_ms)
 
     def to_json(self) -> dict:
         return {
@@ -131,6 +140,7 @@ class Parameters:
             "agg_hold_ms": self.agg_hold_ms,
             "agg_fallback_ms": self.agg_fallback_ms,
             "agg_max_forwards": self.agg_max_forwards,
+            "probe_interval_ms": self.probe_interval_ms,
         }
 
     @staticmethod

@@ -27,6 +27,7 @@ import logging
 import time
 
 from ..crypto import Digest, PublicKey, SignatureService
+from ..network import net
 from ..network.net import NetMessage
 from ..store import Store
 from ..utils import metrics, tracing
@@ -43,6 +44,8 @@ from .messages import (
     TC,
     Block,
     LoopBack,
+    Ping,
+    Pong,
     Round,
     SyncRangeReply,
     SyncRangeRequest,
@@ -179,6 +182,9 @@ class Core:
         # block digest -> first-seen monotonic time, for commit_latency_s
         # (insertion-ordered; bounded by _SEEN_CAP, oldest evicted).
         self._block_seen: dict[Digest, float] = {}
+        # Network-observatory probe sequence (see _probe_loop); runs only
+        # when Parameters.probe_interval_ms > 0.
+        self._probe_seq = 0
 
     @property
     def committee(self):
@@ -1067,6 +1073,84 @@ class Core:
                 self.last_committed_round,
             )
 
+    # -- network observatory probes (network/net.py peer ledger) -------------
+
+    # Peer-RTT-map log cadence: one summary per this many probe rounds
+    # (the lines the benchmark LogParser's NETWORK section scrapes).
+    PROBE_LOG_EVERY = 8
+
+    async def _probe_loop(self) -> None:
+        """Broadcast one Ping per Parameters.probe_interval_ms and fold
+        the answering Pongs into the per-peer RTT EWMAs (network/net.py).
+        Timestamps ride the loop clock, so under the chaos virtual-time
+        loop every measured RTT — and therefore the whole ledger — is a
+        pure function of the seed. Never spawned when the interval is 0:
+        probe frames share the chaos transport's per-link fault streams
+        with protocol traffic, so enabling them is a determinism-pin
+        opt-in, not a default."""
+        interval = self.parameters.probe_interval_ms / 1000.0
+        loop = asyncio.get_running_loop()
+        while True:
+            await asyncio.sleep(interval)
+            self._probe_seq += 1
+            for addr in self.committee.broadcast_addresses(self.name):
+                net.note_probe_sent(addr)
+            ping = Ping(self.name, self._probe_seq, int(loop.time() * 1e6))
+            await self._transmit(ping, None)
+            if self._probe_seq % self.PROBE_LOG_EVERY == 0:
+                self._log_peer_map()
+
+    def _log_peer_map(self) -> None:
+        # NOTE: these log entries are parsed by the benchmark LogParser.
+        snap = net.peer_snapshot()
+        rtts = {
+            peer: s["rtt_ewma_ms"]
+            for peer, s in snap.items()
+            if s["rtt_ewma_ms"] is not None
+        }
+        sent = sum(s["probes_sent"] for s in snap.values())
+        answered = sum(s["pongs_received"] for s in snap.values())
+        if rtts:
+            classes = net.rtt_classes(rtts)
+            log.info(
+                "Peer RTT map: %s peer(s) in %s class(es), worst EWMA %.3f ms",
+                len(rtts),
+                max(classes.values()) + 1,
+                max(rtts.values()),
+            )
+        log.info("Probe summary: %s sent, %s answered", sent, answered)
+
+    async def _handle_ping(self, ping: Ping) -> None:
+        """Answer a peer's probe directly to its origin. Unsigned and
+        stateless by design (see messages.Ping); an origin key outside
+        every known epoch simply gets no reply."""
+        addr = self.epochs.address(ping.origin)
+        if addr is not None:
+            net.note_ping_received(addr)
+        await self._transmit(
+            Pong(ping.origin, self.name, ping.seq, ping.sent_at_us), ping.origin
+        )
+
+    async def _handle_pong(self, pong: Pong) -> None:
+        if pong.origin != self.name:
+            return  # a misrouted (or forged) echo of someone else's probe
+        addr = self.epochs.address(pong.responder)
+        if addr is None:
+            return
+        rtt = (
+            asyncio.get_running_loop().time() - pong.sent_at_us / 1e6
+        )
+        if rtt < 0:
+            return  # echoed stamp from the future: not our clock's probe
+        net.note_pong_rtt(addr, rtt)
+        tracing.event(
+            "net.probe",
+            None,
+            dur=rtt,
+            peer=f"{addr[0]}:{addr[1]}",
+            seq=pong.seq,
+        )
+
     # -- main loop -----------------------------------------------------------
 
     async def run(self) -> None:
@@ -1078,6 +1162,8 @@ class Core:
         self.epochs.note_round(self.round)
         self.synchronizer.note_committed(self.last_committed_round)
         self.timer = Timer(self.parameters.timeout_delay)
+        if self.parameters.probe_interval_ms > 0:
+            spawn(self._probe_loop(), name="consensus-probe")
 
         # Bootstrap: the round-1 leader proposes immediately (core.rs:446-454).
         if self.leader_elector.get_leader(self.round) == self.name:
@@ -1118,6 +1204,10 @@ class Core:
                     await self._handle_sync_range_request(value)
                 elif isinstance(value, SyncRangeReply):
                     await self._handle_sync_range_reply(value)
+                elif isinstance(value, Ping):
+                    await self._handle_ping(value)
+                elif isinstance(value, Pong):
+                    await self._handle_pong(value)
                 elif isinstance(value, LoopBack):
                     await self._process_block(value.block)
                 else:

@@ -563,6 +563,12 @@ TAG_SYNC_RANGE_REPLY = 6
 # Aggregation-overlay partial-quorum bundles (consensus/overlay.py).
 TAG_VOTE_BUNDLE = 7
 TAG_TIMEOUT_BUNDLE = 8
+# Network-observatory RTT probes (network/net.py peer ledger). Probe
+# frames ride the normal consensus framing, so a peer that predates them
+# hits `unknown consensus tag` in decode, counts one net.decode_errors,
+# and drops the frame — the graceful-degradation path for mixed fleets.
+TAG_PING = 9
+TAG_PONG = 10
 
 # Defensive cap on entries per partial bundle: an unauthenticated peer
 # must not make a receiver decode (and batch-verify) an unbounded entry
@@ -622,6 +628,17 @@ def encode_consensus_message(msg) -> bytes:
                 wr.u64(v[2]),
             ),
         )
+    elif isinstance(msg, Ping):
+        w.u8(TAG_PING)
+        w.fixed(msg.origin.data, 32)
+        w.u64(msg.seq)
+        w.u64(msg.sent_at_us)
+    elif isinstance(msg, Pong):
+        w.u8(TAG_PONG)
+        w.fixed(msg.origin.data, 32)
+        w.fixed(msg.responder.data, 32)
+        w.u64(msg.seq)
+        w.u64(msg.sent_at_us)
     else:
         raise TypeError(f"not a consensus message: {msg!r}")
     return w.bytes()
@@ -675,6 +692,12 @@ def decode_consensus_message(data: bytes):
         if len(timeouts) > MAX_BUNDLE_ENTRIES:
             raise SerdeError(f"timeout bundle over entry cap: {len(timeouts)}")
         out = TimeoutBundle(round_, high_qc, timeouts)
+    elif tag == TAG_PING:
+        out = Ping(PublicKey(r.fixed(32)), r.u64(), r.u64())
+    elif tag == TAG_PONG:
+        out = Pong(
+            PublicKey(r.fixed(32)), PublicKey(r.fixed(32)), r.u64(), r.u64()
+        )
     else:
         raise SerdeError(f"unknown consensus tag {tag}")
     r.expect_done()
@@ -753,6 +776,42 @@ class TimeoutBundle:
             f"TB{self.round}(high_qc round {self.high_qc.round}, "
             f"{len(self.timeouts)} timeouts)"
         )
+
+
+@dataclass(frozen=True, slots=True)
+class Ping:
+    """RTT probe (network observatory): `origin` broadcasts one Ping per
+    probe interval; every receiver answers a Pong directly to the origin.
+    Timestamps are MICROSECONDS of the ORIGIN's loop clock (`loop.time()`
+    — the virtual clock under chaos, so measured RTTs replay
+    bit-identically); the responder echoes them opaquely, never
+    interprets them. Unsigned by design: a probe carries no protocol
+    authority, and a forged one costs its victim exactly one reply
+    frame. The origin key is carried in-frame because the receive path
+    does not authenticate frame senders."""
+
+    origin: PublicKey
+    seq: int
+    sent_at_us: int
+
+    def __str__(self) -> str:
+        return f"Ping(seq {self.seq})"
+
+
+@dataclass(frozen=True, slots=True)
+class Pong:
+    """Echo of a Ping, addressed back to its origin. `responder`
+    identifies the measured peer; `sent_at_us` is the origin's own
+    send stamp echoed back, so RTT = now - sent_at_us needs no clock
+    agreement between the two nodes."""
+
+    origin: PublicKey
+    responder: PublicKey
+    seq: int
+    sent_at_us: int
+
+    def __str__(self) -> str:
+        return f"Pong(seq {self.seq})"
 
 
 @dataclass(frozen=True, slots=True)

@@ -59,6 +59,14 @@ _M_DECODE_ERRORS = metrics.counter("net.decode_errors")
 _M_BACKOFF_SECONDS = metrics.counter("net.backoff_seconds")
 _M_BACKOFF_DROPS = metrics.counter("net.backoff_drops")
 
+# Per-peer observatory aggregates (the per-link detail lives in the
+# PeerLink ledger below; these are the process-global roll-ups).
+_M_PEER_LINKS = metrics.counter("net.peer.links")
+_M_PEER_PROBES_SENT = metrics.counter("net.peer.probes_sent")
+_M_PEER_PINGS_RECEIVED = metrics.counter("net.peer.pings_received")
+_M_PEER_PONGS_RECEIVED = metrics.counter("net.peer.pongs_received")
+_M_PEER_RTT_SAMPLES = metrics.counter("net.peer.rtt_samples")
+
 MAX_FRAME = 64 * 1024 * 1024  # defensive cap against Byzantine length prefixes
 
 
@@ -78,6 +86,183 @@ def backoff_jitter_rng(node: object, sender: str, addr: Address) -> random.Rando
         f"net-backoff:{node}:{sender}:{addr[0]}:{addr[1]}".encode()
     ).digest()
     return random.Random(int.from_bytes(digest[:8], "big"))
+
+
+# ---------------------------------------------------------------------------
+# Per-peer link observatory (`net.peer.*`).
+#
+# One PeerLink per DIRECTED (node, peer address) pair, attributed the
+# same way frames and backoff streams are: by the tracing NODE_LABEL
+# contextvar the node's construction scope set. Sender paths (both the
+# TCP workers and the chaos-transport branch) account frames/bytes/
+# drops/backoffs; the consensus probe handlers (consensus/core.py
+# Ping/Pong) feed RTT samples. Everything here is pure bookkeeping
+# driven by loop-clock durations, so under the chaos virtual clock the
+# whole ledger — EWMAs included — replays bit-identically.
+
+# EWMA weight for new RTT samples. 0.2 converges within ~10 probes while
+# still smoothing per-frame chaos jitter; the raw p50 rides alongside so
+# the dash can show both.
+RTT_EWMA_ALPHA = 0.2
+# Bounded raw-sample ring per link (p50 source). Small on purpose: the
+# observatory is always-on bookkeeping, not a histogram service.
+RTT_SAMPLE_CAP = 256
+# Gap threshold (ms) for per-vantage RTT classing: consecutive sorted
+# EWMAs further apart than this start a new class. The chaos WanMatrix's
+# closest inter-region spacing is 20 ms (us-west 62 vs eu-west 82 from
+# us-east), so 15 ms splits every seeded geometry while absorbing EWMA
+# residue from per-frame latency jitter.
+RTT_CLASS_GAP_MS = 15.0
+
+
+class PeerLink:
+    """Per-directed-peer accounting: link counters + RTT estimators."""
+
+    __slots__ = (
+        "frames_sent", "bytes_sent", "drops_full", "backoff_drops",
+        "connects", "reconnects", "send_failures", "probes_sent",
+        "pings_received", "pongs_received", "rtt_ewma_ms", "_rtt_samples",
+    )
+
+    def __init__(self) -> None:
+        self.frames_sent = 0
+        self.bytes_sent = 0
+        self.drops_full = 0
+        self.backoff_drops = 0
+        self.connects = 0
+        self.reconnects = 0
+        self.send_failures = 0
+        self.probes_sent = 0
+        self.pings_received = 0
+        self.pongs_received = 0
+        self.rtt_ewma_ms: float | None = None
+        self._rtt_samples: list[float] = []
+
+    def note_sent(self, nbytes: int) -> None:
+        self.frames_sent += 1
+        self.bytes_sent += nbytes
+
+    def note_rtt(self, rtt_ms: float) -> None:
+        if self.rtt_ewma_ms is None:
+            self.rtt_ewma_ms = rtt_ms
+        else:
+            self.rtt_ewma_ms = (
+                RTT_EWMA_ALPHA * rtt_ms
+                + (1.0 - RTT_EWMA_ALPHA) * self.rtt_ewma_ms
+            )
+        self._rtt_samples.append(rtt_ms)
+        if len(self._rtt_samples) > RTT_SAMPLE_CAP:
+            del self._rtt_samples[0]
+        _M_PEER_RTT_SAMPLES.inc()
+
+    def rtt_p50_ms(self) -> float | None:
+        if not self._rtt_samples:
+            return None
+        ordered = sorted(self._rtt_samples)
+        # Nearest-rank p50, mirroring utils/metrics.percentile.
+        return ordered[max(0, -(-len(ordered) // 2) - 1)]
+
+    def snapshot(self) -> dict:
+        return {
+            "frames_sent": self.frames_sent,
+            "bytes_sent": self.bytes_sent,
+            "drops_full": self.drops_full,
+            "backoff_drops": self.backoff_drops,
+            "connects": self.connects,
+            "reconnects": self.reconnects,
+            "send_failures": self.send_failures,
+            "probes_sent": self.probes_sent,
+            "pings_received": self.pings_received,
+            "pongs_received": self.pongs_received,
+            "rtt_ewma_ms": (
+                round(self.rtt_ewma_ms, 6)
+                if self.rtt_ewma_ms is not None
+                else None
+            ),
+            "rtt_p50_ms": (
+                round(self.rtt_p50_ms(), 6)
+                if self._rtt_samples
+                else None
+            ),
+            "rtt_samples": len(self._rtt_samples),
+        }
+
+
+# node label -> "host:port" -> PeerLink
+_peer_links: dict[object, dict[str, PeerLink]] = {}
+
+
+def _addr_key(addr: Address) -> str:
+    return f"{addr[0]}:{addr[1]}"
+
+
+def peer_link(addr: Address, node: object | None = None) -> PeerLink:
+    """The (create-on-first-touch) ledger entry for `addr` as seen from
+    `node` (default: the calling task's tracing NODE_LABEL)."""
+    if node is None:
+        node = tracing.NODE_LABEL.get()
+    links = _peer_links.setdefault(node, {})
+    key = _addr_key(addr)
+    link = links.get(key)
+    if link is None:
+        link = links[key] = PeerLink()
+        _M_PEER_LINKS.inc()
+    return link
+
+
+def peer_snapshot(node: object | None = None) -> dict[str, dict]:
+    """JSON-ready per-peer view for one node, sorted by peer key so the
+    serialized form is bit-stable across same-seed replays."""
+    if node is None:
+        node = tracing.NODE_LABEL.get()
+    links = _peer_links.get(node) or {}
+    return {key: links[key].snapshot() for key in sorted(links)}
+
+
+def reset_peers() -> None:
+    """Drop every ledger entry (chaos runs start from a clean slate so
+    back-to-back scenarios in one process cannot bleed into each other)."""
+    _peer_links.clear()
+
+
+def note_probe_sent(addr: Address) -> None:
+    """A Ping left for `addr` (consensus/core.py probe ticker)."""
+    peer_link(addr).probes_sent += 1
+    _M_PEER_PROBES_SENT.inc()
+
+
+def note_ping_received(addr: Address) -> None:
+    """A Ping arrived from the peer listening at `addr`."""
+    peer_link(addr).pings_received += 1
+    _M_PEER_PINGS_RECEIVED.inc()
+
+
+def note_pong_rtt(addr: Address, rtt_s: float) -> None:
+    """A Pong closed the loop for `addr`: fold the measured round trip
+    (loop-clock seconds) into the link's EWMA/p50 estimators."""
+    link = peer_link(addr)
+    link.pongs_received += 1
+    link.note_rtt(rtt_s * 1000.0)
+    _M_PEER_PONGS_RECEIVED.inc()
+
+
+def rtt_classes(
+    rtts: dict[str, float], gap_ms: float = RTT_CLASS_GAP_MS
+) -> dict[str, int]:
+    """Cluster peers into RTT classes from ONE vantage: sort by
+    (RTT, peer) and start a new class at every gap wider than `gap_ms`.
+    Class 0 is the nearest band (same-region peers under the chaos
+    WanMatrix). Pure and order-stable — same inputs, same classes."""
+    classes: dict[str, int] = {}
+    cls = -1
+    prev: float | None = None
+    for peer, rtt in sorted(rtts.items(), key=lambda kv: (kv[1], kv[0])):
+        if prev is None or rtt - prev > gap_ms:
+            cls += 1
+        classes[peer] = cls
+        prev = rtt
+    return classes
+
 
 # ---------------------------------------------------------------------------
 # Pluggable transport (the chaos subsystem's fault-injection seam).
@@ -255,6 +440,7 @@ class NetSender:
             if self._transport is not None:
                 # Chaos seam: the transport owns delivery (and the faults).
                 for addr in msg.addresses:
+                    peer_link(addr).note_sent(len(payload))
                     await self._transport.send(addr, payload, urgent=msg.urgent)
                 continue
             for addr in msg.addresses:
@@ -275,6 +461,7 @@ class NetSender:
                 except asyncio.QueueFull:
                     # Fire-and-forget: drop rather than block the fan-out.
                     _M_DROPPED_FULL.inc()
+                    peer_link(addr).drops_full += 1
                     log.debug("dropping message to %s: peer queue full", addr)
 
     async def _worker(
@@ -295,6 +482,7 @@ class NetSender:
         # (orchestrator sets an index per in-process node; node/main.py
         # sets the store name per process).
         jitter = backoff_jitter_rng(tracing.NODE_LABEL.get(), self._name, addr)
+        link = peer_link(addr)
         writer: asyncio.StreamWriter | None = None
         connected_before = False  # reconnects = churn, not initial connects
         backoff = 0.0  # current backoff window (s); 0 = healthy
@@ -309,15 +497,19 @@ class NetSender:
                     # what backoff buys is not hot-looping connect attempts
                     # (one per queued frame) against a partitioned peer.
                     _M_BACKOFF_DROPS.inc()
+                    link.backoff_drops += 1
                     continue
                 try:
                     _, writer = await asyncio.open_connection(addr[0], addr[1])
                     if connected_before:
                         _M_RECONNECTS.inc()
+                        link.reconnects += 1
                     connected_before = True
+                    link.connects += 1
                     backoff = 0.0
                 except OSError as e:
                     _M_SEND_FAILURES.inc()
+                    link.send_failures += 1
                     # Jittered exponential growth, capped AFTER the jitter so
                     # BACKOFF_MAX_S is a true bound: jitter decorrelates the
                     # retry clocks of many senders all aimed at one
@@ -344,8 +536,10 @@ class NetSender:
                 await writer.drain()
                 _M_FRAMES_SENT.inc()
                 _M_BYTES_SENT.inc(len(payload))
+                link.note_sent(len(payload))
             except (ConnectionError, OSError) as e:
                 _M_SEND_FAILURES.inc()
+                link.send_failures += 1
                 log.debug("failed to send to %s: %s", addr, e)
                 try:
                     writer.close()

@@ -78,6 +78,7 @@ async def _run_node(args) -> None:
         # --trace-out auto-dump embeds the last K snapshots.
         import os as _os
 
+        from ..network import net as _net
         from ..ops import timeline
         from ..utils import telemetry
         from ..utils.actors import spawn
@@ -86,6 +87,10 @@ async def _run_node(args) -> None:
             label=_os.path.splitext(_os.path.basename(args.keys))[0],
             lane_stats=node.verification_service.lane_stats,
             timeline_fn=timeline.summary,
+            # Per-peer link/RTT ledger (network observatory): a process
+            # has one node label, so the default-vantage snapshot is
+            # exactly this node's directed links.
+            peers_fn=_net.peer_snapshot,
         )
         plane.attach_watchdog()
         server = telemetry.TelemetryServer(

@@ -637,6 +637,13 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("net.decode_errors", "counter", None),
     ("net.backoff_seconds", "counter", None),
     ("net.backoff_drops", "counter", None),
+    # network/net.py — per-peer link observatory roll-ups (the per-link
+    # detail lives in the PeerLink ledger, not the registry)
+    ("net.peer.links", "counter", None),
+    ("net.peer.probes_sent", "counter", None),
+    ("net.peer.pings_received", "counter", None),
+    ("net.peer.pongs_received", "counter", None),
+    ("net.peer.rtt_samples", "counter", None),
     # chaos/ — deterministic fault injection & invariant checking
     ("chaos.drops", "counter", None),
     ("chaos.delays", "counter", None),
@@ -681,6 +688,7 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("telemetry.slo_burn_fired", "counter", None),
     ("telemetry.slo_burn_cleared", "counter", None),
     ("telemetry.scrapes", "counter", None),
+    ("telemetry.peer_views", "counter", None),
     # ops/timeline.py — device-occupancy timeline
     ("timeline.intervals", "counter", None),
     ("timeline.dropped", "counter", None),

@@ -72,6 +72,9 @@ __all__ = [
     "TelemetryConfig",
     "TelemetryPlane",
     "TelemetryServer",
+    "PeerView",
+    "peer_views",
+    "infer_fleet_regions",
     "scrape",
     "scrape_sync",
     "serve_in_thread",
@@ -84,6 +87,7 @@ _M_SNAPSHOTS = metrics.counter("telemetry.snapshots")
 _M_FIRED = metrics.counter("telemetry.slo_burn_fired")
 _M_CLEARED = metrics.counter("telemetry.slo_burn_cleared")
 _M_SCRAPES = metrics.counter("telemetry.scrapes")
+_M_PEER_VIEWS = metrics.counter("telemetry.peer_views")
 
 # End-to-end verify-latency target for one device batch
 # (verifier.e2e_s): a batch habitually slower than this is a degraded
@@ -258,9 +262,11 @@ class TelemetryPlane:
     `lane_stats` is the owning BatchVerificationService's LaneStats (or a
     zero-arg callable resolving it — the chaos runner re-resolves across
     crash/restart); `timeline_fn` returns the device-occupancy summary
-    (ops/timeline.py `TIMELINE.summary`) for dumps; `clock` defaults to
-    `time.monotonic` and the chaos orchestrator passes its virtual
-    `loop.time`."""
+    (ops/timeline.py `TIMELINE.summary`) for dumps; `peers_fn` returns
+    the node's per-peer observatory snapshot (`network/net.py
+    peer_snapshot` — injected rather than imported, keeping utils/ free
+    of a network dependency); `clock` defaults to `time.monotonic` and
+    the chaos orchestrator passes its virtual `loop.time`."""
 
     def __init__(
         self,
@@ -269,6 +275,7 @@ class TelemetryPlane:
         slos: tuple[SLOSpec, ...] | None = None,
         lane_stats=None,
         timeline_fn=None,
+        peers_fn=None,
         registry: metrics.Registry | None = None,
         clock=None,
     ) -> None:
@@ -277,6 +284,7 @@ class TelemetryPlane:
         self.slos = tuple(slos if slos is not None else default_slos())
         self._lane_stats = lane_stats
         self._timeline_fn = timeline_fn
+        self._peers_fn = peers_fn
         self._registry = registry or metrics.REGISTRY
         self._clock = clock or time.monotonic
         self._ring: deque = deque(maxlen=max(4, self.config.ring))
@@ -603,8 +611,17 @@ class TelemetryPlane:
             ],
             "lanes": ls.summary() if ls is not None else {},
             "device": self._timeline_fn() if self._timeline_fn else None,
+            "peers": self._peer_section(),
             "commits": commits,
         }
+
+    def _peer_section(self) -> dict | None:
+        if self._peers_fn is None:
+            return None
+        peers = self._peers_fn()
+        if peers:
+            _M_PEER_VIEWS.inc()
+        return peers
 
 
 # ---------------------------------------------------------------------------
@@ -689,6 +706,109 @@ def merge_lane_summaries(per_node: dict[str, dict]) -> dict[str, dict]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Per-peer observatory views (the `peers` section of a telemetry dump,
+# fed by network/net.py's PeerLink ledger through `peers_fn`).
+
+
+@dataclass(frozen=True)
+class PeerView:
+    """One directed link's normalized observatory row — the shape the
+    dashboard renders and a future region-aware LeaderElector consumes."""
+
+    peer: str
+    rtt_ewma_ms: float | None
+    rtt_p50_ms: float | None
+    frames_sent: int
+    bytes_sent: int
+    backoff_drops: int
+    probes_sent: int
+    pongs_received: int
+
+    @staticmethod
+    def from_snapshot(peer: str, snap: dict) -> "PeerView":
+        return PeerView(
+            peer=str(peer),
+            rtt_ewma_ms=snap.get("rtt_ewma_ms"),
+            rtt_p50_ms=snap.get("rtt_p50_ms"),
+            frames_sent=int(snap.get("frames_sent") or 0),
+            bytes_sent=int(snap.get("bytes_sent") or 0),
+            backoff_drops=int(snap.get("backoff_drops") or 0),
+            probes_sent=int(snap.get("probes_sent") or 0),
+            pongs_received=int(snap.get("pongs_received") or 0),
+        )
+
+
+def peer_views(peers: dict[str, dict] | None) -> list[PeerView]:
+    """A dump's `peers` section as sorted PeerView rows."""
+    return [
+        PeerView.from_snapshot(peer, snap or {})
+        for peer, snap in sorted((peers or {}).items())
+    ]
+
+
+# Fleet region inference: two nodes share a region iff a measured RTT
+# EWMA between them sits under this bound. The chaos WanMatrix separates
+# intra-region (4 ms) from the closest inter-region RTT (62 ms) by more
+# than a decade, so 30 ms recovers the seeded geometry exactly while
+# tolerating per-frame jitter folded into the EWMAs.
+REGION_RTT_THRESHOLD_MS = 30.0
+
+
+def infer_fleet_regions(
+    latency_ms: dict[str, dict[str, float]],
+    threshold_ms: float = REGION_RTT_THRESHOLD_MS,
+) -> dict[str, str]:
+    """Partition nodes into RTT-derived regions: union-find over every
+    measured link whose EWMA is under `threshold_ms` (either direction
+    suffices — links are directed but latency is symmetric enough).
+    Labels are synthetic (`rtt-0`, `rtt-1`, ... ordered by each group's
+    smallest member), so callers compare PARTITIONS against ground
+    truth, not label strings. Pure and deterministic."""
+    nodes = sorted(
+        set(latency_ms) | {b for m in latency_ms.values() for b in m}
+    )
+    parent = {n: n for n in nodes}
+
+    def find(x: str) -> str:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a in sorted(latency_ms):
+        for b, rtt in sorted((latency_ms.get(a) or {}).items()):
+            if rtt is not None and rtt <= threshold_ms:
+                ra, rb = find(a), find(b)
+                if ra != rb:
+                    parent[max(ra, rb)] = min(ra, rb)
+    groups: dict[str, list[str]] = {}
+    for n in nodes:
+        groups.setdefault(find(n), []).append(n)
+    labels = {
+        root: f"rtt-{k}"
+        for k, root in enumerate(
+            sorted(groups, key=lambda r: min(groups[r]))
+        )
+    }
+    return {n: labels[find(n)] for n in nodes}
+
+
+def peer_latency_map(peers: dict[str, dict]) -> dict[str, dict[str, float]]:
+    """{node: {peer: link snapshot}} -> {node: {peer: RTT EWMA ms}},
+    keeping only links with at least one closed probe loop."""
+    out: dict[str, dict[str, float]] = {}
+    for a, links in sorted((peers or {}).items()):
+        row = {
+            str(b): float(s["rtt_ewma_ms"])
+            for b, s in sorted((links or {}).items())
+            if isinstance(s, dict) and s.get("rtt_ewma_ms") is not None
+        }
+        if row:
+            out[str(a)] = row
+    return out
+
+
 # Counter prefixes a matrix cell keeps from the scenario's metric deltas:
 # the scale/health counters a regression diff is judged on, not the full
 # delta dump (which stays in the per-scenario report).
@@ -756,6 +876,34 @@ def fleet_rollup(report: dict) -> dict:
         }
     )
     metrics_delta = report.get("metrics") or {}
+    # Fleet latency map (network observatory): prefer the report's
+    # top-level `peers` section (present even without telemetry planes);
+    # degrade to the per-dump `peers` embeds.
+    peers = report.get("peers") or {
+        str(label): dump.get("peers") or {} for label, dump in telem.items()
+    }
+    latency = peer_latency_map(peers)
+    peer_rtt = None
+    if latency:
+        inferred = infer_fleet_regions(latency)
+        cross = [
+            rtt
+            for a, row in latency.items()
+            for b, rtt in row.items()
+            if inferred.get(a) != inferred.get(b)
+        ]
+        peer_rtt = {
+            "links": sum(len(row) for row in latency.values()),
+            "worst_ewma_ms": round(
+                max(rtt for row in latency.values() for rtt in row.values()),
+                3,
+            ),
+            "worst_cross_region_ewma_ms": (
+                round(max(cross), 3) if cross else None
+            ),
+            "inferred_regions": inferred,
+            "region_count": len(set(inferred.values())),
+        }
     return {
         "nodes": report.get("nodes"),
         "crypto_mode": report.get("crypto_mode", "exact"),
@@ -796,6 +944,7 @@ def fleet_rollup(report: dict) -> dict:
             for k, v in sorted(metrics_delta.items())
             if k.startswith(_ROLLUP_COUNTER_PREFIXES)
         },
+        "peer_rtt": peer_rtt,
         "fault_trace_truncated": bool(report.get("fault_trace_truncated")),
     }
 

@@ -103,6 +103,7 @@ STAGES: tuple[str, ...] = (
 EVENT_KINDS: frozenset[str] = frozenset(STAGES) | {
     "net.send",
     "net.recv",
+    "net.probe",
     "timer.arm",
     "timer.fire",
     "timeout",

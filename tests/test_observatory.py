@@ -1,0 +1,365 @@
+"""Network-observatory acceptance: per-peer RTT EWMAs + link accounting
+(network/net.py PeerLink), probe wire compatibility (consensus/messages.py
+Ping/Pong), fleet region inference (utils/telemetry.py), the per-round
+critical-path attribution (tools/trace_report.py), the dashboard peer view
+(tools/telemetry_dash.py --peers), and the benchmark NETWORK log scrape
+(benchmark/logs.py).
+
+The chaos-marked tests pin the ISSUE acceptance: measured RTT classes
+deterministically recover the seeded WanMatrix region geometry, and the
+same seed replays the per-peer ledger bit-identically (probe frames draw
+no RNG and ride the virtual clock, so they must not perturb replays).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from hotstuff_tpu.consensus.messages import (
+    TAG_PING,
+    TAG_PONG,
+    TAG_PROPOSE,
+    TAG_TIMEOUT_BUNDLE,
+    Ping,
+    Pong,
+    decode_consensus_message,
+    encode_consensus_message,
+)
+from hotstuff_tpu.crypto import PublicKey
+from hotstuff_tpu.network import net
+from hotstuff_tpu.utils.serde import SerdeError
+from hotstuff_tpu.utils.telemetry import (
+    fleet_rollup,
+    infer_fleet_regions,
+    peer_latency_map,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import telemetry_dash  # noqa: E402
+import trace_report  # noqa: E402
+
+_PK_A = PublicKey(bytes(range(32)))
+_PK_B = PublicKey(bytes(range(32, 64)))
+
+
+# --- probe wire format ------------------------------------------------------
+
+
+def test_ping_pong_roundtrip():
+    ping = Ping(_PK_A, 7, 1_234_567)
+    assert decode_consensus_message(encode_consensus_message(ping)) == ping
+    pong = Pong(_PK_A, _PK_B, 7, 1_234_567)
+    assert decode_consensus_message(encode_consensus_message(pong)) == pong
+
+
+def test_wire_tags_stable():
+    """Probe frames extend the tag space; every pre-probe tag keeps its
+    value so a probe-less peer still decodes everything it always could
+    (the new->old half of the interop contract)."""
+    assert (TAG_PROPOSE, TAG_TIMEOUT_BUNDLE) == (0, 8)
+    assert (TAG_PING, TAG_PONG) == (9, 10)
+
+
+def test_unknown_probe_tag_degrades_to_serde_error():
+    """The old->new half: a probe-less peer's decoder is this decoder
+    minus the probe branches, so TAG_PING reaches its unknown-tag arm.
+    Pin the two properties that make that graceful: probe frames lead
+    with their tag (an old reader fails before misparsing a payload),
+    and an unknown tag raises SerdeError — the exact exception both
+    receive paths (NetReceiver._handle, FaultyTransport._deliver) catch,
+    count as net.decode_errors, and skip."""
+    frame = encode_consensus_message(Ping(_PK_A, 1, 2))
+    assert frame[0] == TAG_PING
+    with pytest.raises(SerdeError):
+        decode_consensus_message(bytes([47]) + frame[1:])
+
+
+# --- per-peer link ledger ---------------------------------------------------
+
+
+def test_peer_link_ewma_and_p50():
+    link = net.PeerLink()
+    assert link.rtt_ewma_ms is None and link.rtt_p50_ms() is None
+    link.note_rtt(10.0)
+    assert link.rtt_ewma_ms == pytest.approx(10.0)  # first sample seeds
+    link.note_rtt(20.0)
+    assert link.rtt_ewma_ms == pytest.approx(12.0)  # 0.8*10 + 0.2*20
+    assert link.rtt_p50_ms() == pytest.approx(10.0)  # nearest rank of [10,20]
+    snap = link.snapshot()
+    assert snap["rtt_samples"] == 2
+    assert snap["rtt_ewma_ms"] == pytest.approx(12.0)
+
+
+def test_peer_link_sample_window_is_bounded():
+    link = net.PeerLink()
+    for i in range(net.RTT_SAMPLE_CAP + 50):
+        link.note_rtt(float(i))
+    assert link.snapshot()["rtt_samples"] == net.RTT_SAMPLE_CAP
+
+
+def test_rtt_classes_gap_clustering():
+    rtts = {"a": 4.0, "b": 62.0, "c": 82.0, "d": 63.0}
+    # gaps: a->b 58 (split), b->d 1 (merge), d->c 19 (split at 15 ms)
+    assert net.rtt_classes(rtts) == {"a": 0, "b": 1, "d": 1, "c": 2}
+    assert net.rtt_classes({}) == {}
+
+
+def test_peer_registry_is_per_vantage_and_resettable():
+    net.reset_peers()
+    try:
+        net.peer_link(("10.0.0.1", 9000), node="x").note_sent(100)
+        net.peer_link(("10.0.0.1", 9000), node="y").note_sent(7)
+        assert net.peer_snapshot("x")["10.0.0.1:9000"]["bytes_sent"] == 100
+        assert net.peer_snapshot("y")["10.0.0.1:9000"]["bytes_sent"] == 7
+        assert net.peer_snapshot("z") == {}
+    finally:
+        net.reset_peers()
+    assert net.peer_snapshot("x") == {}
+
+
+# --- fleet region inference -------------------------------------------------
+
+
+def test_infer_fleet_regions_unions_sub_threshold_edges():
+    latency = {
+        "0": {"1": 4.0, "2": 82.0, "3": 82.0},
+        "1": {"0": 4.0},
+        "2": {"3": 4.0},
+        "3": {},
+    }
+    regions = infer_fleet_regions(latency)
+    assert regions["0"] == regions["1"]
+    assert regions["2"] == regions["3"]
+    assert regions["0"] != regions["2"]
+    # labels are ordered by each group's smallest member
+    assert regions["0"] == "rtt-0" and regions["2"] == "rtt-1"
+
+
+def test_peer_latency_map_keeps_only_measured_links():
+    peers = {
+        "0": {"1": {"rtt_ewma_ms": 5.0}, "2": {"rtt_ewma_ms": None}},
+        "1": {},
+    }
+    assert peer_latency_map(peers) == {"0": {"1": 5.0}}
+
+
+# --- critical-path attribution ----------------------------------------------
+
+_TRACE = "r1-" + "0" * 16
+
+
+def _synthetic_blocks():
+    return {
+        _TRACE: {
+            "0": {
+                "propose": 0.0,
+                "payload": 0.010,
+                "verify": 0.020,
+                "vote": 0.030,
+                "qc": 0.050,
+                "commit": 0.060,
+            },
+            "1": {
+                "propose": 0.112,
+                "payload": 0.112,
+                "verify": 0.160,
+                "vote": 0.170,
+                "qc": 0.180,
+                "commit": 0.260,
+            },
+        }
+    }
+
+
+def test_critical_path_chains_cross_node_maxima():
+    cp = trace_report.critical_path(_synthetic_blocks())[_TRACE]
+    assert cp["leader"] == "0"
+    assert cp["total_s"] == pytest.approx(0.260)
+    segs = {s: (e - b, g) for s, b, e, g in cp["segments"]}
+    assert segs["payload"][0] == pytest.approx(0.112)
+    assert segs["payload"][1] == "1"  # the gating (slowest) node
+    assert segs["verify"][0] == pytest.approx(0.048)
+    assert segs["commit"][0] == pytest.approx(0.080)
+
+
+def test_critical_path_table_annotates_measured_propose_hop():
+    table = trace_report.critical_path_table(
+        _synthetic_blocks(), {"0": {"1": 224.0}}
+    )
+    assert "Per-round critical path" in table
+    assert "112.0 (43%) @1" in table  # payload segment: ms, share, gating
+    assert "112.0 (0->1)" in table  # measured leader->gating half-RTT
+    assert "dominant segment: payload" in table
+    # without an RTT ledger the hop column degrades to '-'
+    assert "(0->1)" not in trace_report.critical_path_table(_synthetic_blocks())
+
+
+def test_chrome_trace_renders_critical_path_lane():
+    nodes = [
+        {
+            "node": label,
+            "offset": 0.0,
+            "events": [
+                {"kind": s, "t": t, "trace": _TRACE}
+                for s, t in _synthetic_blocks()[_TRACE][label].items()
+            ],
+            "intervals": [],
+        }
+        for label in ("0", "1")
+    ]
+    chrome = trace_report.chrome_trace(nodes)
+    cp = [e for e in chrome["traceEvents"] if e.get("cat") == "critical-path"]
+    assert cp, "critical-path lane missing"
+    # the lane rides the LEADER's process so the pid set stays the node set
+    assert {e["pid"] for e in cp} == {0}
+    assert all(e["tid"] == trace_report._CP_TID for e in cp)
+    lanes = [
+        e
+        for e in chrome["traceEvents"]
+        if e.get("name") == "thread_name"
+        and e["args"]["name"] == "critical-path"
+    ]
+    assert len(lanes) == 1 and lanes[0]["pid"] == 0
+    assert {e["pid"] for e in chrome["traceEvents"]} == {0, 1}
+
+
+def test_load_peer_rtts_reads_report_section(tmp_path):
+    path = tmp_path / "r.json"
+    path.write_text(
+        json.dumps(
+            {"peers": {"0": {"1": {"rtt_ewma_ms": 62.0, "frames_sent": 3}}}}
+        )
+    )
+    assert trace_report.load_peer_rtts([str(path)]) == {"0": {"1": 62.0}}
+    assert trace_report.load_peer_rtts([str(tmp_path / "missing.json")]) == {}
+
+
+# --- dashboard peer view ----------------------------------------------------
+
+_REPORT_PEERS = {
+    "0": {
+        "1": {
+            "rtt_ewma_ms": 62.0,
+            "rtt_p50_ms": 62.0,
+            "rtt_samples": 3,
+            "frames_sent": 10,
+            "bytes_sent": 1000,
+            "backoff_drops": 1,
+            "probes_sent": 4,
+            "pongs_received": 3,
+        },
+        "2": {"frames_sent": 2, "bytes_sent": 200},
+    }
+}
+
+
+def test_peer_record_normalizes_and_classes():
+    rec = telemetry_dash.peer_record("0", _REPORT_PEERS["0"])
+    assert rec["node"] == "0" and rec["rtt_classes"] == 1
+    by_peer = {link["peer"]: link for link in rec["links"]}
+    assert by_peer["1"]["rtt_class"] == 0
+    assert by_peer["2"]["rtt_class"] is None  # never closed a probe loop
+    assert by_peer["2"]["probes_sent"] == 0  # absent fields default
+
+
+def test_dash_peers_offline_rc_contract(tmp_path, capsys):
+    path = tmp_path / "chaos.json"
+    path.write_text(json.dumps({"peers": _REPORT_PEERS}))
+    assert telemetry_dash.main(["--report", str(path), "--peers"]) == 0
+    out = capsys.readouterr().out
+    assert "Peer observatory" in out and "62.00" in out
+    assert (
+        telemetry_dash.main(["--report", str(path), "--peers", "--json"]) == 0
+    )
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["nodes"][0]["links"][0]["rtt_ewma_ms"] == 62.0
+
+
+def test_dash_peers_rejects_matrix_input(tmp_path, capsys):
+    path = tmp_path / "m.json"
+    path.write_text(json.dumps({"kind": "chaos_matrix", "cells": []}))
+    assert telemetry_dash.main(["--matrix", str(path), "--peers"]) == 3
+
+
+# --- benchmark log scrape ---------------------------------------------------
+
+
+def test_log_parser_scrapes_network_section():
+    from benchmark.logs import LogParser
+    from tests.test_harness import CLIENT_LOG, NODE_LOG
+
+    assert "+ NETWORK" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node = NODE_LOG + (
+        "[2026-07-30T10:00:01.000Z INFO hotstuff.node] Probe interval set "
+        "to 250 ms\n"
+        "[2026-07-30T10:00:03.000Z INFO hotstuff.consensus] Peer RTT map: "
+        "3 peer(s) in 2 class(es), worst EWMA 158.321 ms\n"
+        "[2026-07-30T10:00:05.000Z INFO hotstuff.consensus] Peer RTT map: "
+        "3 peer(s) in 3 class(es), worst EWMA 120.000 ms\n"
+        "[2026-07-30T10:00:05.001Z INFO hotstuff.consensus] Probe summary: "
+        "12 sent, 9 answered\n"
+    )
+    p = LogParser([CLIENT_LOG], [node])
+    # last map line wins for shape; worst EWMA keeps the max ever logged
+    assert p.peer_rtts == [(3, 3, 158.321)]
+    assert (p.probes_sent, p.probes_answered) == (12, 9)
+    assert p.configs["probe_interval"] == 250
+    out = p.result()
+    assert "+ NETWORK:" in out
+    assert "Worst peer RTT EWMA: 158.3 ms" in out
+    assert "12 sent, 9 answered (3 outstanding = 25.0 %)" in out
+
+
+# --- chaos acceptance: geometry recovery + replay determinism ---------------
+
+
+@pytest.mark.chaos
+def test_wan_observatory_replays_bit_identically_and_recovers_geometry():
+    """ISSUE acceptance, both halves in one double run: (a) the measured
+    per-peer ledger — every EWMA bit, every counter — is identical for
+    the same seed (probes ride the virtual clock and draw no RNG), and
+    (b) the fleet-level inference clusters the measured latencies into
+    exactly the seeded WanMatrix partition (compared as partitions;
+    inferred labels are synthetic rtt-k names)."""
+    from hotstuff_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("wan_observatory", seed=7)
+    b = run_scenario("wan_observatory", seed=7)
+    assert a["ok"], a.get("expectation_failures") or a
+    assert json.dumps(a["peers"], sort_keys=True) == json.dumps(
+        b["peers"], sort_keys=True
+    )
+
+    latency = peer_latency_map(a["peers"])
+    inferred = infer_fleet_regions(latency)
+    truth = a["wan_regions"]
+
+    def partition(regions):
+        groups = {}
+        for node, label in regions.items():
+            groups.setdefault(label, set()).add(str(node))
+        return {frozenset(g) for g in groups.values()}
+
+    assert partition(inferred) == partition(truth)
+
+    # the fleet rollup surfaces the same map for dashboards/matrix cells
+    rollup = fleet_rollup(a)
+    pr = rollup["peer_rtt"]
+    assert pr is not None
+    assert pr["links"] == 12  # n*(n-1) directed links all measured
+    assert pr["region_count"] == len(partition(truth))
+    assert pr["worst_cross_region_ewma_ms"] == pytest.approx(224.0, abs=1.0)
+
+    # and the critical-path table renders with measured hop annotations
+    nodes = [
+        {"node": label, "offset": 0.0, "events": evs, "intervals": []}
+        for label, evs in sorted(a["flight_recorders"].items())
+    ]
+    blocks = trace_report.stage_times(nodes)
+    table = trace_report.critical_path_table(
+        blocks, {n: {p: s["rtt_ewma_ms"] for p, s in row.items()} for n, row in a["peers"].items()}
+    )
+    assert "Per-round critical path" in table
+    assert "dominant segment:" in table

@@ -15,6 +15,11 @@ device occupancy, and SLO burn alerts — one renderer for both sources.
     # regression markers against the artifact's recorded baseline
     python tools/telemetry_dash.py --matrix CHAOS_MATRIX_r01.json
 
+    # per-peer network observatory: one row per directed link — RTT
+    # EWMA/p50, frames/bytes, backoff drops, and the RTT class inferred
+    # from this node's vantage (gap clustering, network/net.py)
+    python tools/telemetry_dash.py --report chaos.json --peers
+
     # machine-readable (same normalized records either way)
     python tools/telemetry_dash.py --report chaos.json --json
 
@@ -75,6 +80,63 @@ def node_record(label: object, dump: dict) -> dict:
     }
 
 
+def peer_record(label: object, links: dict) -> dict:
+    """Normalize one node's per-peer link ledger (a live dump's or chaos
+    report's `peers[<node>]` section) into the peer-table record. Pure
+    function of the section — the same live/offline equivalence contract
+    as node_record. The `rtt_class` column is the per-vantage gap
+    clustering (network/net.py rtt_classes) over this node's measured
+    EWMAs; links that never closed a probe loop class as '-'."""
+    from hotstuff_tpu.network.net import rtt_classes
+
+    rtts = {
+        peer: float(snap["rtt_ewma_ms"])
+        for peer, snap in (links or {}).items()
+        if (snap or {}).get("rtt_ewma_ms") is not None
+    }
+    classes = rtt_classes(rtts)
+    rows = []
+    for peer, snap in sorted((links or {}).items()):
+        snap = snap or {}
+        rows.append(
+            {
+                "peer": str(peer),
+                "rtt_ewma_ms": snap.get("rtt_ewma_ms"),
+                "rtt_p50_ms": snap.get("rtt_p50_ms"),
+                "rtt_samples": int(snap.get("rtt_samples", 0)),
+                "rtt_class": classes.get(peer),
+                "frames_sent": int(snap.get("frames_sent", 0)),
+                "bytes_sent": int(snap.get("bytes_sent", 0)),
+                "backoff_drops": int(snap.get("backoff_drops", 0)),
+                "send_failures": int(snap.get("send_failures", 0)),
+                "probes_sent": int(snap.get("probes_sent", 0)),
+                "pongs_received": int(snap.get("pongs_received", 0)),
+            }
+        )
+    return {
+        "node": str(label),
+        "links": rows,
+        "rtt_classes": max(classes.values()) + 1 if classes else 0,
+    }
+
+
+def peer_records_from_report(report: dict) -> list[dict]:
+    """Per-node peer records from a chaos report: the top-level `peers`
+    section (chaos/orchestrator.py, present without telemetry), falling
+    back to each telemetry dump's embedded `peers`."""
+    peers = report.get("peers") or {}
+    if not peers:
+        peers = {
+            label: dump.get("peers") or {}
+            for label, dump in sorted((report.get("telemetry") or {}).items())
+        }
+    return [
+        peer_record(label, links)
+        for label, links in sorted(peers.items())
+        if links
+    ]
+
+
 def records_from_report(report: dict) -> list[dict]:
     """Per-node records from a chaos report. Prefers the embedded
     `telemetry` section; degrades to scheduler/commit_times so reports
@@ -103,7 +165,9 @@ def records_from_report(report: dict) -> list[dict]:
     return out
 
 
-def records_from_poll(targets: list[str], timeout: float) -> tuple[list[dict], list[str]]:
+def records_from_poll(
+    targets: list[str], timeout: float, peers: bool = False
+) -> tuple[list[dict], list[str]]:
     from hotstuff_tpu.utils.telemetry import scrape_sync
 
     records, errors = [], []
@@ -117,7 +181,11 @@ def records_from_poll(targets: list[str], timeout: float) -> tuple[list[dict], l
         except Exception as e:
             errors.append(f"{target}: {type(e).__name__}: {e}")
             continue
-        records.append(node_record(target, dump))
+        if peers:
+            label = dump.get("node") if dump.get("node") is not None else target
+            records.append(peer_record(label, dump.get("peers") or {}))
+        else:
+            records.append(node_record(target, dump))
     return records, errors
 
 
@@ -235,6 +303,32 @@ def render_markdown(records: list[dict], mode: str) -> str:
     return "\n".join(lines)
 
 
+def _fmt_ms(v) -> str:
+    return f"{v:.2f}" if isinstance(v, (int, float)) else "-"
+
+
+def render_peers(records: list[dict], mode: str) -> str:
+    lines = [
+        f"### Peer observatory ({mode}, {len(records)} node(s))\n",
+        "| node | peer | rtt ewma (ms) | rtt p50 (ms) | samples | class "
+        "| frames | bytes | backoff drops | probes sent | pongs |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for rec in records:
+        for link in rec["links"]:
+            cls = link["rtt_class"]
+            lines.append(
+                f"| {rec['node']} | {link['peer']} "
+                f"| {_fmt_ms(link['rtt_ewma_ms'])} "
+                f"| {_fmt_ms(link['rtt_p50_ms'])} | {link['rtt_samples']} "
+                f"| {cls if cls is not None else '-'} "
+                f"| {link['frames_sent']} | {link['bytes_sent']} "
+                f"| {link['backoff_drops']} | {link['probes_sent']} "
+                f"| {link['pongs_received']} |"
+            )
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="telemetry_dash", description=__doc__)
     src = ap.add_mutually_exclusive_group(required=True)
@@ -260,10 +354,24 @@ def main(argv: list[str] | None = None) -> int:
         help="emit the normalized per-node records as one JSON object "
         "instead of markdown",
     )
+    ap.add_argument(
+        "--peers",
+        action="store_true",
+        help="render the per-peer network observatory (RTT EWMA/p50, "
+        "link accounting, per-vantage RTT class) instead of the node "
+        "dashboard; needs --poll or --report",
+    )
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
     errors: list[str] = []
+    if args.matrix and args.peers:
+        print(
+            "--peers renders per-node link tables; matrix artifacts only "
+            "carry fleet rollups — use --report/--poll",
+            file=sys.stderr,
+        )
+        return 3
     if args.matrix:
         try:
             with open(args.matrix) as f:
@@ -301,7 +409,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.poll:
         mode = "live"
         records, errors = records_from_poll(
-            [t.strip() for t in args.poll.split(",") if t.strip()], args.timeout
+            [t.strip() for t in args.poll.split(",") if t.strip()],
+            args.timeout,
+            peers=args.peers,
         )
     else:
         mode = "offline"
@@ -318,7 +428,11 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 3
-        records = records_from_report(report)
+        records = (
+            peer_records_from_report(report)
+            if args.peers
+            else records_from_report(report)
+        )
 
     if args.json:
         print(
@@ -329,7 +443,7 @@ def main(argv: list[str] | None = None) -> int:
             )
         )
     else:
-        print(render_markdown(records, mode))
+        print(render_peers(records, mode) if args.peers else render_markdown(records, mode))
         for e in errors:
             print(f"poll error: {e}", file=sys.stderr)
     return 2 if errors else 0

@@ -27,6 +27,14 @@ Outputs:
     bundle hops (entries merged per upward frame) and gossip fallbacks
     from `agg.bundle` / `agg.fallback` events; in the Chrome trace these
     render on their own "aggregation" lane per node.
+  * a **per-round critical-path table** — for every committed block, the
+    slowest chain through the stage sequence: each segment's duration is
+    the gap between consecutive cross-node stage maxima (the last node
+    to finish stage k gates stage k+1 on the commit path), with percent
+    shares ("round 7: 62% payload hop, 21% verify") and — when the
+    input is a chaos report carrying the per-peer `peers` RTT section —
+    the measured leader->laggard half-RTT annotated on the payload
+    segment, separating propagation from fetch/verify cost.
   * an **ingress-leg table** — the client path's admission
     (recv -> admit) and queue+verify (admit -> forward) legs aggregated
     from `ingress.*` events, plus shed/reject counts (ROADMAP item 3's
@@ -57,6 +65,9 @@ _BLOCK_TRACE = re.compile(r"^r(\d+)-([0-9a-f]{16})$")
 # per-node device-slot rows (which start at tid 2 and grow with pipeline
 # depth), so the lanes never collide.
 _AGG_TID = 32
+# Critical-path slices render on the leading node's process (so the pid
+# set stays exactly the node set) under their own thread row.
+_CP_TID = 33
 
 
 def load_inputs(paths: list[str]) -> list[dict]:
@@ -115,6 +126,29 @@ def load_inputs(paths: list[str]) -> list[dict]:
              "intervals": []}
         )
     return nodes
+
+
+def load_peer_rtts(paths: list[str]) -> dict[str, dict[str, float]]:
+    """Measured per-peer RTT EWMAs from a chaos report's `peers` section
+    (network observatory, chaos/orchestrator.py): node label -> peer
+    label -> rtt_ewma_ms. Both key layers are node indices as strings,
+    matching the flight-recorder labels, so the critical-path table can
+    look up the leader->laggard link directly. Per-node dump files carry
+    no peer ledger; they simply contribute nothing here."""
+    rtts: dict[str, dict[str, float]] = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for label, links in sorted((d.get("peers") or {}).items()):
+            row = rtts.setdefault(str(label), {})
+            for peer, snap in sorted((links or {}).items()):
+                ewma = (snap or {}).get("rtt_ewma_ms")
+                if ewma is not None:
+                    row[str(peer)] = float(ewma)
+    return {label: row for label, row in rtts.items() if row}
 
 
 def stage_times(nodes: list[dict]) -> dict:
@@ -179,6 +213,109 @@ def latency_table(blocks: dict, honest: set[str] | None = None) -> str:
         f"| block | round | {head} | full-coverage nodes |\n"
         "|---|---|" + "---|" * len(STAGES) + "---|\n"
         + "\n".join(rows)
+    )
+
+
+# Critical-path segments: everything after the leader's propose stamp.
+_CP_SEGMENTS = STAGES[1:]
+
+
+def critical_path(blocks: dict) -> dict[str, dict]:
+    """Per committed block, the slowest chain through the stage sequence.
+
+    Stage k+1 cannot complete fleet-wide before the last node finishes
+    stage k, so the cross-node MAX of each stage's earliest stamp is the
+    gating time and the gaps between consecutive maxima are the segment
+    durations. Segments are clamped monotone (a stage whose max precedes
+    the previous one contributes 0 — it was off the path, hidden under
+    the earlier segment). Returns trace -> {"leader", "t0", "total_s",
+    "segments": [(stage, start, end, gating node)]} for every block with
+    a propose AND a commit stamp; ties pick the smallest node label so
+    replays attribute identically."""
+    out: dict[str, dict] = {}
+    for trace in sorted(blocks, key=_round_of):
+        per_node = blocks[trace]
+        t0s = sorted(
+            (ts["propose"], n) for n, ts in per_node.items() if "propose" in ts
+        )
+        if not t0s or not any("commit" in ts for ts in per_node.values()):
+            continue
+        t0, leader = t0s[0]
+        prev = t0
+        segments = []
+        for stage in _CP_SEGMENTS:
+            stamped = sorted(
+                (ts[stage], n) for n, ts in per_node.items() if stage in ts
+            )
+            if not stamped:
+                segments.append((stage, prev, prev, "-"))
+                continue
+            t_max = stamped[-1][0]
+            gating = min(n for t, n in stamped if t == t_max)
+            end = max(t_max, prev)
+            segments.append((stage, prev, end, gating))
+            prev = end
+        out[trace] = {
+            "leader": leader,
+            "t0": t0,
+            "total_s": prev - t0,
+            "segments": segments,
+        }
+    return out
+
+
+def critical_path_table(blocks: dict, rtts: dict | None = None) -> str:
+    """Markdown per-round critical-path attribution: each segment as
+    `ms (share%) @gating-node`, plus the measured leader->gating-node
+    half-RTT for the payload segment (the propose hop) when the input
+    carried a peer RTT ledger — that separates wire propagation from
+    fetch/verify work inside the same segment."""
+    paths = critical_path(blocks)
+    if not paths:
+        return ""
+    rtts = rtts or {}
+    rows = []
+    shares: dict[str, list[float]] = {s: [] for s in _CP_SEGMENTS}
+    for trace, cp in paths.items():
+        total = cp["total_s"]
+        if total <= 0:
+            continue
+        cells = []
+        for stage, start, end, gating in cp["segments"]:
+            dur_ms = (end - start) * 1000.0
+            share = (end - start) / total
+            shares[stage].append(share)
+            cells.append(
+                f"{dur_ms:.1f} ({share * 100.0:.0f}%) @{gating}"
+                if end > start
+                else "-"
+            )
+        hop = "-"
+        payload = cp["segments"][0]
+        link = rtts.get(cp["leader"], {}).get(payload[3])
+        if link is not None and payload[3] != cp["leader"]:
+            hop = f"{link / 2.0:.1f} ({cp['leader']}->{payload[3]})"
+        rows.append(
+            f"| {trace} | r{_round_of(trace)} | {total * 1000.0:.1f} | "
+            + " | ".join(cells)
+            + f" | {hop} |"
+        )
+    if not rows:
+        return ""
+    mean = {
+        s: (sum(v) / len(v) if v else 0.0) for s, v in shares.items()
+    }
+    dominant = max(sorted(mean), key=lambda s: mean[s])
+    head = " | ".join(_CP_SEGMENTS)
+    return (
+        "### Per-round critical path (cross-node stage maxima; "
+        "ms, share of total, gating node)\n\n"
+        f"| block | round | total (ms) | {head} | propose hop rtt/2 (ms) |\n"
+        "|---|---|---|" + "---|" * len(_CP_SEGMENTS) + "---|\n"
+        + "\n".join(rows)
+        + "\n\nmean shares: "
+        + ", ".join(f"{s} {mean[s] * 100.0:.0f}%" for s in _CP_SEGMENTS)
+        + f" — dominant segment: {dominant}"
     )
 
 
@@ -529,6 +666,41 @@ def chrome_trace(nodes: list[dict]) -> dict:
             else:
                 entry.update(ph="i", ts=ts, s="t")
             events.append(entry)
+    # Critical-path lane: each block's gating chain as duration slices on
+    # the LEADING node's process (keeps the pid set == the node set) under
+    # a dedicated thread row. Segment args carry the gating node so the
+    # slice answers "who held round N up" without leaving the timeline.
+    cp_pids = set()
+    for trace, cp in critical_path(stage_times(nodes)).items():
+        pid = pids.get(cp["leader"])
+        if pid is None:
+            continue
+        if pid not in cp_pids:
+            cp_pids.add(pid)
+            events.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": pid,
+                    "tid": _CP_TID,
+                    "args": {"name": "critical-path"},
+                }
+            )
+        for stage, start, end, gating in cp["segments"]:
+            if end <= start:
+                continue
+            events.append(
+                {
+                    "name": f"cp.{stage}",
+                    "cat": "critical-path",
+                    "ph": "X",
+                    "pid": pid,
+                    "tid": _CP_TID,
+                    "ts": (start - (base or 0.0)) * 1e6,
+                    "dur": (end - start) * 1e6,
+                    "args": {"trace": trace, "gating": gating},
+                }
+            )
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -563,6 +735,7 @@ def main(argv: list[str] | None = None) -> int:
     print()
     print(latency_table(blocks))
     for section in (
+        critical_path_table(blocks, load_peer_rtts(args.dumps)),
         verify_lane_table(nodes),
         agg_bundle_table(nodes),
         ingress_leg_table(nodes),
