@@ -24,6 +24,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import sys
@@ -654,6 +655,151 @@ def bench_scheduler_ab(args) -> None:
     _emit(payload, args.metrics_out, args.trace_out)
 
 
+def bench_aggregate_ab(args) -> None:
+    """`--aggregate-ab`: entry-list vs aggregate-certificate A/B over
+    committee sizes (§5.5o) — the AGG_AB_rN.json artifact. Per size n:
+    the wire bytes of a real encoded n-vote QC vs the AggQC (one BLS
+    signature + the fixed 64-byte bitmap), and the verify cost of each
+    form (n exact ed25519 checks vs one exact pairing over the
+    device-summed aggregate key). Self-contained and jax-optional: the
+    G1 committee kernel (ops/bls.py) is probed and the exact host
+    backend substitutes when it is absent; any failure degrades rc-0
+    with backend=error, like every other bench mode."""
+    payload: dict = {
+        "metric": "aggregate_cert_bytes",
+        "value": 0.0,
+        "unit": "bytes",
+    }
+    try:
+        from hotstuff_tpu.consensus.messages import QC, AggQC
+        from hotstuff_tpu.crypto import aggsig, pysigner
+        from hotstuff_tpu.crypto.primitives import Digest, PublicKey, Signature
+        from hotstuff_tpu.utils.serde import Writer
+
+        scheme = aggsig.exact_scheme()
+        backend = "exact-host"
+        kernel_error = None
+        table_cls = None
+        try:
+            from hotstuff_tpu.ops import bls as bls_ops
+
+            if bls_ops.HAVE_JAX:
+                table_cls = bls_ops.CommitteeTable
+                backend = "g1-kernel"
+            else:
+                kernel_error = "jax unavailable; exact host aggregation"
+        except Exception as e:  # probe-and-degrade, never rc != 0
+            kernel_error = f"{type(e).__name__}: {e}"
+
+        sizes = [int(s) for s in args.agg_sizes.split(",") if s.strip()]
+        rows = []
+        for n in sizes:
+            digest = Digest(hashlib.sha512(b"agg-ab:%d" % n).digest()[:32])
+            round_ = 7
+
+            # Entry-list leg: a real n-vote QC through the wire codec,
+            # verified the way the legacy path does (n exact ed25519
+            # checks of the shared vote digest).
+            seeds = [hashlib.sha512(b"ed:%d:%d" % (n, i)).digest()[:32]
+                     for i in range(n)]
+            ed_pks = [pysigner.keypair_from_seed(s)[0] for s in seeds]
+            qc = QC(digest, round_, ())
+            msg = qc.signed_digest().data
+            votes = tuple(
+                (PublicKey(pk), Signature(pysigner.sign_exact(s, msg)))
+                for pk, s in zip(ed_pks, seeds)
+            )
+            qc = QC(digest, round_, votes)
+            w = Writer()
+            qc.encode(w)
+            entry_bytes = len(w.bytes())
+            t0 = time.perf_counter()
+            entry_ok = all(
+                pysigner.verify_exact(pk.data, msg, sig.data)
+                for pk, sig in qc.votes
+            )
+            entry_wall = time.perf_counter() - t0
+
+            # Aggregate leg: same-message BLS aggregation means the
+            # aggregate signature equals a signature under the summed
+            # secret scalar — one G2 mul builds the n-member cert the
+            # verifier cannot tell apart from n combined partials.
+            pairs = [scheme.keypair_from_seed(s) for s in seeds]
+            agg_pks = [pk for pk, _sk in pairs]
+            sk_sum = sum(sk for _pk, sk in pairs) % aggsig.R_ORDER
+            bitmap = (1 << n) - 1
+            agg_sig = scheme.sign(sk_sum, msg)
+            aqc = AggQC(digest, round_, bitmap, agg_sig)
+            w = Writer()
+            aqc.encode(w)
+            agg_bytes = len(w.bytes())
+
+            table_build_s = None
+            if table_cls is not None:
+                t0 = time.perf_counter()
+                table = table_cls(agg_pks)
+                table_build_s = round(time.perf_counter() - t0, 4)
+                t0 = time.perf_counter()
+                agg_ok = table.verify_aggregate(bitmap, msg, agg_sig)
+                agg_wall = time.perf_counter() - t0
+            else:
+                t0 = time.perf_counter()
+                agg_ok = scheme.verify(agg_pks, msg, agg_sig)
+                agg_wall = time.perf_counter() - t0
+
+            rows.append(
+                {
+                    "n": n,
+                    "entry_list": {
+                        "cert_bytes": entry_bytes,
+                        "verify_ok": bool(entry_ok),
+                        "verify_wall_s": round(entry_wall, 4),
+                        "certs_per_s": round(1.0 / entry_wall, 3)
+                        if entry_wall > 0
+                        else None,
+                    },
+                    "aggregate": {
+                        "cert_bytes": agg_bytes,
+                        "verify_ok": bool(agg_ok),
+                        "verify_wall_s": round(agg_wall, 4),
+                        "certs_per_s": round(1.0 / agg_wall, 3)
+                        if agg_wall > 0
+                        else None,
+                        "table_build_s": table_build_s,
+                    },
+                    "bytes_ratio": round(entry_bytes / agg_bytes, 3),
+                }
+            )
+
+        agg_sizes_seen = [r["aggregate"]["cert_bytes"] for r in rows]
+        payload.update(
+            {
+                "value": float(agg_sizes_seen[-1]),
+                "sizes": rows,
+                # The O(1) claim in one number: the aggregate cert's byte
+                # spread across the swept committee sizes (1.0 = perfectly
+                # flat; the acceptance gate wants <= 1.1).
+                "agg_bytes_spread": round(
+                    max(agg_sizes_seen) / min(agg_sizes_seen), 4
+                ),
+                "all_verified": all(
+                    r["entry_list"]["verify_ok"] and r["aggregate"]["verify_ok"]
+                    for r in rows
+                ),
+                "backend": backend,
+            }
+        )
+        if kernel_error is not None:
+            payload["error"] = kernel_error
+    except Exception as e:
+        print(
+            f"# aggregate A/B failed: {type(e).__name__}: {e}", file=sys.stderr
+        )
+        payload["backend"] = "error"
+        payload["error"] = f"{type(e).__name__}: {e}"
+    _emit(payload, args.metrics_out, args.trace_out)
+
+
 def _pipeline_workload(n: int):
     """Deterministic signed workload for the pipeline A/B, dependency-free
     (pysigner, no `cryptography` wheel needed): 8 exact-int RFC 8032
@@ -914,6 +1060,20 @@ def main() -> None:
         help="auto = device path with a verify probe, degrading to the "
         "pure-python verifier; pure = dependency-free pure-python",
     )
+    ap.add_argument(
+        "--aggregate-ab",
+        action="store_true",
+        help="A/B entry-list vs aggregate certificates per committee size: "
+        "encoded QC vs AggQC wire bytes and exact verify cost (n ed25519 "
+        "checks vs one pairing over the G1-kernel-summed aggregate key) — "
+        "the AGG_AB_rN.json artifact; degrades rc-0 with backend/error "
+        "fields, jax optional",
+    )
+    ap.add_argument(
+        "--agg-sizes",
+        default="4,16,64",
+        help="comma-separated committee sizes for --aggregate-ab",
+    )
     ap.add_argument("--sched-duration", type=float, default=6.0)
     ap.add_argument("--sched-bulk", type=int, default=512)
     ap.add_argument("--sched-critical", type=int, default=44)
@@ -949,6 +1109,12 @@ def main() -> None:
     if args.scheduler_ab:
         # Likewise self-contained: its own probe, its own workload.
         bench_scheduler_ab(args)
+        return
+
+    if args.aggregate_ab:
+        # Exact-integer certificate A/B; probes the G1 kernel itself and
+        # never needs the relay bootstrap below.
+        bench_aggregate_ab(args)
         return
 
     from hotstuff_tpu.ops import check_axon_relay, enable_persistent_cache

@@ -198,6 +198,18 @@ def _parse_node(text: str) -> dict:
             r"Agg fallback round (\d+): (\d+) entries to (\d+) peers", text
         )
     ]
+    # Certificate-plane line (consensus/core.py _commit): cumulative
+    # aggregate-vs-entry-list cert counts, the worst committed cert's
+    # wire bytes, and the deepest aggregation merge tree seen. Cumulative
+    # per node, so the LAST line wins.
+    certs = _search_all(
+        r"Cert plane: (\d+) aggregate / (\d+) entry-list certs committed, "
+        r"worst cert (\d+) B, agg depth (\d+)",
+        text,
+    )
+    out["cert_plane"] = (
+        tuple(int(x) for x in certs[-1]) if certs else None
+    )
     # Network-observatory lines (consensus/core.py _log_peer_map): the
     # periodic per-vantage RTT map and cumulative probe counters. Both
     # are cumulative/monotone per node, so the LAST line wins — except
@@ -350,6 +362,13 @@ class LogParser:
         # quorum and (round, entries, peers) per gossip fallback.
         self.agg_quorums: list[tuple[str, int, int]] = []
         self.agg_fallbacks: list[tuple[int, int, int]] = []
+        # Certificate-plane fold (cumulative per-node lines): counts sum
+        # across nodes; worst bytes / aggregation depth take the max.
+        self.cert_agg = 0
+        self.cert_legacy = 0
+        self.cert_worst_bytes = 0
+        self.cert_depth = 0
+        self.cert_nodes = 0
         # Network-observatory scrapes: (peers, classes, worst EWMA ms) per
         # node that logged an RTT map, plus fleet probe send/answer totals.
         self.peer_rtts: list[tuple[int, int, float]] = []
@@ -396,6 +415,13 @@ class LogParser:
             self.range_blocks += r.get("range_blocks", 0)
             self.agg_quorums.extend(r.get("agg_quorums", []))
             self.agg_fallbacks.extend(r.get("agg_fallbacks", []))
+            if r.get("cert_plane") is not None:
+                n_agg, n_legacy, worst_b, depth = r["cert_plane"]
+                self.cert_agg += n_agg
+                self.cert_legacy += n_legacy
+                self.cert_worst_bytes = max(self.cert_worst_bytes, worst_b)
+                self.cert_depth = max(self.cert_depth, depth)
+                self.cert_nodes += 1
             if r.get("peer_rtt") is not None:
                 self.peer_rtts.append(r["peer_rtt"])
             if r.get("probes") is not None:
@@ -674,6 +700,19 @@ class LogParser:
                     f" Fallbacks: {len(self.agg_fallbacks)}"
                     f" ({gossiped:,} entries gossiped over {frames:,} frames)\n"
                 )
+        certs = ""
+        if self.cert_nodes:
+            total_certs = self.cert_agg + self.cert_legacy
+            agg_pct = 100.0 * self.cert_agg / total_certs if total_certs else 0.0
+            certs = (
+                " + CERTS:\n"
+                f" Committed certificates: {total_certs:,}"
+                f" ({self.cert_agg:,} aggregate = {agg_pct:.1f} %,"
+                f" {self.cert_legacy:,} entry-list)"
+                f" across {self.cert_nodes} node(s)\n"
+                f" Worst cert: {self.cert_worst_bytes:,} B,"
+                f" aggregation depth {self.cert_depth}\n"
+            )
         reconfig = ""
         if self.epoch_switches or self.handoffs or self.range_lags:
             reconfig = " + RECONFIG:\n"
@@ -760,6 +799,7 @@ class LogParser:
             + lint
             + matrix
             + agg
+            + certs
             + reconfig
             + mtr
             + "-----------------------------------------\n"

@@ -130,6 +130,21 @@ class SafetyChecker:
             )
             return
         msg = qc.signed_digest().data
+        if not hasattr(qc, "votes"):
+            # Aggregate form (messages.AggQC): no per-entry signatures to
+            # re-check — the independent audit is a full re-verification
+            # of the ONE aggregate signature against the bitmap members'
+            # registered aggregate keys (byte-exact under the trusted-agg
+            # stub, a pairing under exact BLS), preserving the
+            # zero-false-accept contract for aggregate fleets.
+            try:
+                qc.verify(committee)
+            except Exception as e:
+                self._violate(
+                    f"FALSE ACCEPT: committed aggregate QC (round {qc.round}) "
+                    f"fails re-verification at node {node}: {e}"
+                )
+            return
         for pk, sig in qc.votes:
             if not pysigner.verify(pk.data, msg, sig.data):
                 self._violate(

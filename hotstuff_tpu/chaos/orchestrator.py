@@ -37,7 +37,7 @@ from ..consensus.mempool_driver import (
     PayloadStatus,
 )
 from ..consensus.reconfig import EpochChange, EpochManager
-from ..crypto import pysigner
+from ..crypto import aggsig, pysigner
 from ..crypto.backend import set_backend
 from ..crypto.batch_service import BatchVerificationService
 from ..crypto.primitives import Digest, PublicKey, Signature
@@ -236,6 +236,26 @@ class ChaosOrchestrator:
                 for i in self.committee_indices
             ]
         )
+        # Aggregate-certificate plane (§5.5o): when the run's Parameters
+        # opt into aggregate_certs, every node gets an aggregate signing
+        # identity derived from its own key seed — the trusted-agg stub
+        # in trusted_crypto fleets, exact BLS otherwise — and the
+        # identity -> aggregate-pk registry (the proof-of-possession
+        # boundary certificates resolve bitmap members through) covers
+        # the whole fleet. Installed for the run's duration in run().
+        self.agg_scheme = None
+        self.agg_registry: dict[bytes, bytes] | None = None
+        if self.parameters.aggregate_certs:
+            if trusted_crypto:
+                from .trusted_crypto import TrustedAggScheme
+
+                self.agg_scheme = TrustedAggScheme()
+            else:
+                self.agg_scheme = aggsig.exact_scheme()
+            self.agg_registry = {
+                pk.data: self.agg_scheme.keypair_from_seed(seed_)[0]
+                for pk, seed_ in self.keys
+            }
         if reconfig is None:
             self.reconfigs: list[ReconfigDirective] = []
         elif isinstance(reconfig, ReconfigDirective):
@@ -402,6 +422,11 @@ class ChaosOrchestrator:
                     epoch_manager=node.epochs,
                     listen_address=("127.0.0.1", BASE_PORT + i),
                     overlay_regions=self.overlay_regions,
+                    agg_signer=(
+                        aggsig.AggSigner(node.seed, self.agg_scheme)
+                        if self.agg_scheme is not None
+                        else None
+                    ),
                 )
                 spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
         finally:
@@ -783,6 +808,12 @@ class ChaosOrchestrator:
         # EpochChange construction, the SafetyChecker audit — so a run is
         # never half-stubbed (restored in the finally with the rest).
         prev_scheme = pysigner.install_scheme(self.crypto_scheme)
+        # Aggregate plane seam: scheme + key registry are process-global
+        # (like the pysigner scheme), installed per run and restored with
+        # it — a non-agg run installs None/empty, so a stale registry
+        # from a prior run can never leak into this one's verification.
+        prev_agg_scheme = aggsig.install_agg_scheme(self.agg_scheme)
+        prev_agg_registry = aggsig.install_agg_registry(self.agg_registry)
         run_scope = SpawnScope("chaos-run")
         loop = asyncio.get_running_loop()
         # Flight-recorder events follow the VIRTUAL clock for this run, so
@@ -850,6 +881,8 @@ class ChaosOrchestrator:
             net.install_transport(prev_transport)
             set_backend(prev_backend)
             pysigner.install_scheme(prev_scheme)
+            aggsig.install_agg_scheme(prev_agg_scheme)
+            aggsig.install_agg_registry(prev_agg_registry)
             for plane in self.telemetry_planes.values():
                 plane.detach_watchdog()
             tracing.WATCHDOG.remove_dump_hook(_capture)

@@ -129,6 +129,17 @@ class Scenario:
     # everything else stays capped so cells remain comparable across
     # matrix revisions.
     cell_duration: float | None = None
+    # Scenario REQUIRES the trusted-crypto stub at every size (not just
+    # from TRUSTED_CRYPTO_MIN_N up): the aggregate-certificate cells,
+    # whose exact-BLS pairing (~0.4 s per verification) is unrunnable in
+    # a virtual-time fleet at ANY committee size. Read the trust model
+    # in chaos/trusted_crypto.py before setting this.
+    trusted_crypto: bool = False
+    # Per-scenario matrix-size override (None = the grid's MATRIX_SIZES):
+    # how the aggregate cells extend the grid to n=128 — the committee
+    # size the constant-size-certificate claim is about — without
+    # tripling every legacy scenario's cell count.
+    matrix_sizes: tuple[int, ...] | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -939,6 +950,62 @@ _register(
 )
 
 
+def _agg_cert_params(timeout_ms: int = 1_000) -> Parameters:
+    p = _agg_params(timeout_ms)
+    p.aggregate_certs = True
+    return p
+
+
+# Upper bound on committed certificate bytes per commit EVENT in an
+# aggregate cell, independent of committee size: one AggQC (172 B under
+# the 64-byte trusted-agg stub signature) plus headroom for a stall
+# round's AggTC. Legacy cells at n=64 run ~4.3 KB per QC — the O(1)
+# claim is this constant's n-independence, asserted per cell.
+AGG_CERT_BYTES_PER_COMMIT = 400
+
+
+def _expect_agg_certs(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "agg.qcs_formed", minimum=4)
+    problems += _expect_counter(deltas, "agg.cert_bytes_committed")
+    problems += _expect_counter(deltas, "chaos.stub_agg_verifies")
+    if deltas.get("agg.partial_rejects", 0):
+        problems.append(
+            f"fault-free aggregate fleet rejected "
+            f"{deltas['agg.partial_rejects']} partials"
+        )
+    commits = deltas.get("consensus.commits", 0)
+    if commits:
+        per = deltas.get("agg.cert_bytes_committed", 0) / commits
+        if per > AGG_CERT_BYTES_PER_COMMIT:
+            problems.append(
+                f"certificate bytes per committed round {per:.0f} exceeds "
+                f"the size-independent bound {AGG_CERT_BYTES_PER_COMMIT} — "
+                "the constant-size claim regressed"
+            )
+    return problems
+
+
+_register(
+    Scenario(
+        name="agg_certs",
+        description="Constant-size certificates (§5.5o): every vote and "
+        "timeout rides as a singleton aggregate partial, interior overlay "
+        "nodes merge bitmap-disjoint partials Handel-style, and committed "
+        "blocks carry AggQC/AggTC — one aggregate signature plus a "
+        "committee bitmap — so certificate bytes per committed round stay "
+        "flat from n=4 to n=128 (the matrix column the O(1) claim is "
+        "pinned by). Runs the trusted-agg stub at every size: the exact "
+        "BLS pairing is for unit tests and the A/B bench, not fleets.",
+        plan=lambda: FaultPlan(default_link=_LINK, wan=WanMatrix()),
+        parameters=_agg_cert_params,
+        trusted_crypto=True,
+        matrix_sizes=(4, 64, 128),
+        min_commits=4,
+        expect=_expect_agg_certs,
+    )
+)
+
+
 def _expect_agg_crash(report: dict, deltas: dict) -> list[str]:
     problems = _expect_counter(deltas, "chaos.crashes")
     problems += _expect_counter(deltas, "chaos.restarts")
@@ -1457,6 +1524,11 @@ MATRIX_SCENARIOS = (
     "timeout_storm_legacy",
     "rolling_churn",
     "wan_observatory",
+    # ISSUE 17's constant-size-certificate cells: aggregate QC/TC under
+    # the trusted-agg stub, extended to n=128 via its matrix_sizes
+    # override (the committee size the O(1) bytes-per-committed-round
+    # claim is about).
+    "agg_certs",
 )
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)
@@ -1510,7 +1582,9 @@ def run_matrix_cell(
     if trusted not in ("auto", "on", "off"):
         raise ValueError(f"trusted must be auto|on|off, got {trusted!r}")
     trusted_crypto = (
-        trusted == "on" or (trusted == "auto" and n >= TRUSTED_CRYPTO_MIN_N)
+        trusted == "on"
+        or (trusted == "auto" and n >= TRUSTED_CRYPTO_MIN_N)
+        or SCENARIOS[scenario].trusted_crypto
     )
     if duration is None:
         # The cell cap bounds a REGRESSED cell's wall cost; only a
@@ -1646,7 +1720,7 @@ def run_scenario(
             boundary_crashes=(
                 scenario.boundary_crashes() if scenario.boundary_crashes else None
             ),
-            trusted_crypto=trusted_crypto,
+            trusted_crypto=trusted_crypto or scenario.trusted_crypto,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

@@ -109,6 +109,15 @@ class Parameters:
     agg_hold_ms: int = 50  # interior merge window before forwarding up
     agg_fallback_ms: int = 500  # stalled-round bound before gossip fallback
     agg_max_forwards: int = 3  # upward re-forwards per (round, kind) key
+    # Constant-size certificates (§5.5o): votes/timeouts carry aggregate
+    # partials (one combined signature + committee bitmap) instead of
+    # per-entry signature lists, and QC/TC wire forms become AggQC/AggTC.
+    # Requires an installed aggsig scheme + key registry (the chaos
+    # orchestrator wires both in trusted_crypto fleets). Default OFF:
+    # legacy entry-list certificates are the committed-determinism
+    # baseline, and mixed fleets interop by decoding both forms.
+    aggregate_certs: bool = False
+    agg_window: int = 8  # Handel score window: best-N partials kept per key
     # Network-observatory RTT probing (network/net.py peer ledger,
     # consensus/core.py probe ticker). 0 disables it — the default,
     # because probe frames share the chaos transport's per-link fault
@@ -140,6 +149,8 @@ class Parameters:
             "agg_hold_ms": self.agg_hold_ms,
             "agg_fallback_ms": self.agg_fallback_ms,
             "agg_max_forwards": self.agg_max_forwards,
+            "aggregate_certs": self.aggregate_certs,
+            "agg_window": self.agg_window,
             "probe_interval_ms": self.probe_interval_ms,
         }
 

@@ -39,6 +39,7 @@ class Consensus:
         epoch_manager: EpochManager | None = None,
         listen_address: Address | None = None,
         overlay_regions: dict[PublicKey, str] | None = None,
+        agg_signer=None,
     ) -> Core:
         """Boot the consensus plane; returns the Core (its actor task is
         spawned). The committee addresses are this plane's listen ports.
@@ -55,7 +56,11 @@ class Consensus:
         port to catch up and participate from. `overlay_regions` maps
         authority keys to WAN region labels for the aggregation overlay's
         region-aware tree (consensus/overlay.py); only consulted when
-        Parameters.aggregation_overlay is on."""
+        Parameters.aggregation_overlay is on. `agg_signer` is this
+        node's aggregate-scheme signing handle (crypto/aggsig.AggSigner);
+        required — together with Parameters.aggregate_certs — for the
+        node to EMIT aggregate votes/timeouts (§5.5o); inbound aggregate
+        certificates are understood regardless."""
         # NOTE: boot-time config echo; parsed by the benchmark harness.
         parameters.log(log)
 
@@ -100,6 +105,7 @@ class Consensus:
             commit_channel,
             verification_service=verification_service,
             overlay_regions=overlay_regions,
+            agg_signer=agg_signer,
         )
         spawn(core.run(), name="consensus-core")
         log.info(

@@ -26,22 +26,31 @@ import asyncio
 import logging
 import time
 
-from ..crypto import Digest, PublicKey, SignatureService
+from ..crypto import Digest, PublicKey, SignatureService, aggsig
 from ..network import net
 from ..network.net import NetMessage
 from ..store import Store
 from ..utils import metrics, tracing
 from ..utils.actors import Selector, Timer, spawn
 from ..utils.serde import Reader, Writer
-from .aggregator import Aggregator
+from .aggregator import AggCertAggregator, Aggregator
 from .config import Committee, Parameters
-from .errors import ConsensusError, WrongLeaderError, ensure
+from .errors import (
+    ConsensusError,
+    InvalidSignatureError,
+    WrongLeaderError,
+    ensure,
+)
 from .leader import LeaderElector
 from .mempool_driver import MempoolDriver
 from .messages import (
     MAX_RANGE_BATCH,
     QC,
     TC,
+    AggQC,
+    AggTC,
+    AggTimeoutBundle,
+    AggVoteBundle,
     Block,
     LoopBack,
     Ping,
@@ -54,9 +63,15 @@ from .messages import (
     TimeoutBundle,
     Vote,
     VoteBundle,
+    _bitmap_members,
+    _resolve_agg_keys,
     _timeout_digest,
     _vote_digest,
+    decode_any_qc,
+    decode_stored_block,
+    encode_any_qc,
     encode_consensus_message,
+    encode_stored_block,
 )
 from .overlay import (
     KIND_TIMEOUT,
@@ -76,6 +91,10 @@ from .synchronizer import (
 log = logging.getLogger("hotstuff.consensus")
 
 _SAFETY_KEY = b"safety-state"
+# Leading-u64 sentinel marking the VERSIONED safety-state layout (round
+# numbers never reach 2^64-1): the legacy layout cannot carry an AggQC
+# high_qc, and legacy bytes must keep decoding byte-identically.
+_SAFETY_AGG_SENTINEL = 0xFFFFFFFFFFFFFFFF
 
 # Stage tracing for the protocol state machine (COMPONENTS.md metric table).
 _M_PROPOSALS = metrics.counter("consensus.proposals")
@@ -92,6 +111,12 @@ _M_RANGE_SERVED = metrics.counter("sync.range_served")
 _M_RANGE_REPLIES = metrics.counter("sync.range_replies")
 _M_RANGE_BLOCKS = metrics.counter("sync.range_blocks")
 _M_PARKED = metrics.counter("sync.parked_blocks")
+# Aggregate certificate plane (§5.5o). cert_bytes_committed counts the
+# encoded certificate bytes of EVERY committed block regardless of mode,
+# so legacy and aggregate matrix cells expose comparable
+# bytes_per_committed_round columns (utils/telemetry.fleet_rollup).
+_M_AGG_PARTIAL_REJECTS = metrics.counter("agg.partial_rejects")
+_M_AGG_CERT_BYTES = metrics.counter("agg.cert_bytes_committed")
 
 # Cap on the first-seen timestamp map feeding commit_latency_s: Byzantine
 # proposals that never commit must not grow it without bound.
@@ -114,6 +139,7 @@ class Core:
         commit_channel: asyncio.Queue,
         verification_service=None,
         overlay_regions: dict[PublicKey, str] | None = None,
+        agg_signer: "aggsig.AggSigner | None" = None,
     ) -> None:
         from ..crypto.batch_service import BatchVerificationService
 
@@ -142,7 +168,23 @@ class Core:
         self.round: Round = 1
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
-        self.high_qc: QC = QC.genesis()
+        self.high_qc: QC | AggQC = QC.genesis()
+        # Constant-size certificate plane (§5.5o): with aggregate_certs
+        # on AND an aggregate signing key wired, this node's votes and
+        # timeouts ride as singleton-bitmap partials and its quorums form
+        # AggQC/AggTC. Inbound aggregate traffic is ALWAYS understood
+        # (mixed-fleet interop); only the node's own emissions are gated.
+        self.agg_signer = agg_signer
+        self.agg = bool(parameters.aggregate_certs) and agg_signer is not None
+        self.agg_aggregator = AggCertAggregator(
+            self.epochs, window=parameters.agg_window
+        )
+        # Cumulative cert-plane commit stats feeding the "Cert plane:"
+        # log line (benchmark LogParser's + CERTS section).
+        self._agg_certs_committed = 0
+        self._legacy_certs_committed = 0
+        self._worst_cert_bytes = 0
+        self._agg_depth_max = 0
         # The aggregator seeds verified vote/timeout signatures into the
         # service's dedup cache, so assembled QCs/TCs short-circuit.
         self.aggregator = Aggregator(self.epochs, self.verification_service)
@@ -159,7 +201,7 @@ class Core:
         # node that misses one stays a round behind the fleet for the
         # rest of a stall — fatal when the committee's quorum needs
         # every member (small post-churn committees).
-        self.last_tc: TC | None = None
+        self.last_tc: TC | AggTC | None = None
         # Lag-recovery reply dedup: author -> (last_tc.round sent, when).
         # The stale-timeout branch deliberately spends no crypto, so an
         # unauthenticated flood forging a staked author could otherwise
@@ -225,10 +267,19 @@ class Core:
         if raw is None:
             return
         r = Reader(raw)
-        self.round = r.u64()
-        self.last_voted_round = r.u64()
-        self.last_committed_round = r.u64()
-        self.high_qc = QC.decode(r)
+        first = r.u64()
+        if first == _SAFETY_AGG_SENTINEL:
+            # Versioned layout: the high_qc may be either certificate form.
+            r.u8()  # layout version (1)
+            self.round = r.u64()
+            self.last_voted_round = r.u64()
+            self.last_committed_round = r.u64()
+            self.high_qc = decode_any_qc(r)
+        else:
+            self.round = first
+            self.last_voted_round = r.u64()
+            self.last_committed_round = r.u64()
+            self.high_qc = QC.decode(r)
         log.info(
             "Recovered safety state: round %s, last_voted %s",
             self.round,
@@ -237,10 +288,20 @@ class Core:
 
     async def _store_safety_state(self) -> None:
         w = Writer()
-        w.u64(self.round)
-        w.u64(self.last_voted_round)
-        w.u64(self.last_committed_round)
-        self.high_qc.encode(w)
+        if isinstance(self.high_qc, AggQC):
+            # Sentinel-prefixed versioned layout; a legacy-form high_qc
+            # keeps writing the historical bytes untouched.
+            w.u64(_SAFETY_AGG_SENTINEL)
+            w.u8(1)
+            w.u64(self.round)
+            w.u64(self.last_voted_round)
+            w.u64(self.last_committed_round)
+            encode_any_qc(w, self.high_qc)
+        else:
+            w.u64(self.round)
+            w.u64(self.last_voted_round)
+            w.u64(self.last_committed_round)
+            self.high_qc.encode(w)
         await self.store.write(_SAFETY_KEY, w.bytes())
 
     # -- helpers -------------------------------------------------------------
@@ -279,13 +340,21 @@ class Core:
         return tracing.context_for(round_, digest.data)
 
     async def _store_block(self, block: Block) -> None:
-        w = Writer()
-        block.encode(w)
-        await self.store.write(block.digest().data, w.bytes())
+        await self.store.write(block.digest().data, encode_stored_block(block))
+
+    def _agg_bit(self, round_: Round) -> int | None:
+        """This node's bit position in round_'s committee bitmap (sorted
+        key order — the AggQC/AggTC convention); None when not a member
+        of that round's committee."""
+        keys = self.epochs.committee_for_round(round_).sorted_keys()
+        try:
+            return keys.index(self.name)
+        except ValueError:
+            return None
 
     # -- voting & committing -------------------------------------------------
 
-    async def _make_vote(self, block: Block) -> Vote | None:
+    async def _make_vote(self, block: Block) -> Vote | AggVoteBundle | None:
         """Safety rules (core.rs:106-123), plus the epoch-final
         certification wall: while a next-epoch handoff is pending, this
         node refuses to help certify any round at or past the declared
@@ -311,8 +380,15 @@ class Core:
         self.last_voted_round = block.round
         await self._store_safety_state()
         digest = block.digest()
-        from .messages import _vote_digest
-
+        if self.agg:
+            # Aggregate mode: the vote IS a singleton-bitmap partial —
+            # one aggregate-scheme signature over the same vote digest,
+            # mergeable by any interior node on its way to the leader.
+            bit = self._agg_bit(block.round)
+            if bit is None:
+                return None
+            sig = self.agg_signer.sign(_vote_digest(digest, block.round).data)
+            return AggVoteBundle(block.round, digest, 1 << bit, sig)
         signature = await self.signature_service.request_signature(
             _vote_digest(digest, block.round)
         )
@@ -338,7 +414,7 @@ class Core:
             if raw is None:
                 log.error("missing ancestor during commit of %s", block)
                 break
-            parent = Block.decode(Reader(raw))
+            parent = decode_stored_block(raw)
             if parent.round <= self.last_committed_round:
                 break
             to_commit.append(parent)
@@ -378,6 +454,7 @@ class Core:
         for b in reversed(to_commit):
             d = b.digest()
             _M_COMMITS.inc()
+            self._note_cert_stats(b)
             seen = self._block_seen.pop(d, None)
             if seen is not None:
                 _M_COMMIT_LATENCY.record(now - seen)
@@ -393,10 +470,40 @@ class Core:
             for payload_digest in b.payload:
                 log.info("Committed B%s(%s) -> %s", b.round, d, payload_digest)
             await self.commit_channel.put(b)
+        # NOTE: parsed by the benchmark LogParser (+ CERTS section).
+        log.info(
+            "Cert plane: %d aggregate / %d entry-list certs committed, "
+            "worst cert %d B, agg depth %d",
+            self._agg_certs_committed,
+            self._legacy_certs_committed,
+            self._worst_cert_bytes,
+            self._agg_depth_max,
+        )
+
+    def _note_cert_stats(self, block: Block) -> None:
+        """Per-committed-block certificate accounting: the encoded bytes
+        feed agg.cert_bytes_committed (the fleet_rollup
+        bytes_per_committed_round numerator, counted in EVERY mode so
+        legacy and aggregate cells compare), the form split and worst
+        size feed the cumulative "Cert plane:" line."""
+        certs = [] if block.qc.is_genesis() else [block.qc]
+        if block.tc is not None:
+            certs.append(block.tc)
+        for cert in certs:
+            w = Writer()
+            cert.encode(w)
+            size = len(w.bytes())
+            _M_AGG_CERT_BYTES.inc(size)
+            if size > self._worst_cert_bytes:
+                self._worst_cert_bytes = size
+            if isinstance(cert, (AggQC, AggTC)):
+                self._agg_certs_committed += 1
+            else:
+                self._legacy_certs_committed += 1
 
     # -- round pacing --------------------------------------------------------
 
-    async def _process_qc(self, qc: QC) -> None:
+    async def _process_qc(self, qc: QC | AggQC) -> None:
         """Adopt a higher QC and advance past its round (core.rs:263-276,321)."""
         if self.epochs.handoff_pending() and not qc.is_genesis():
             # Epoch-final commit unlock: with a handoff pending, the
@@ -439,13 +546,13 @@ class Core:
         raw = await self.store.read(qc.hash.data)
         if raw is None:
             return
-        b1 = Block.decode(Reader(raw))
+        b1 = decode_stored_block(raw)
         if b1.qc.is_genesis() or b1.qc.round + 1 != b1.round:
             return
         raw0 = await self.store.read(b1.parent().data)
         if raw0 is None:
             return
-        b0 = Block.decode(Reader(raw0))
+        b0 = decode_stored_block(raw0)
         if b0.round <= self.last_committed_round:
             return
         _M_HANDOFF_COMMITS.inc()
@@ -483,6 +590,7 @@ class Core:
         if self.timer is not None:
             self.timer.reset()
         self.aggregator.cleanup(self.round)
+        self.agg_aggregator.cleanup(self.round)
         self.overlay.cleanup(self.round)
         # Round/high_qc persistence piggybacks on the next pre-vote or
         # pre-timeout safety write (exactly one flushed write per round);
@@ -501,12 +609,22 @@ class Core:
         log.warning("Timeout reached for round %s", self.round)
         self.last_voted_round = max(self.last_voted_round, self.round)
         await self._store_safety_state()
-        from .messages import _timeout_digest
-
-        signature = await self.signature_service.request_signature(
-            _timeout_digest(self.round, self.high_qc.round)
-        )
-        timeout = Timeout(self.high_qc, self.round, self.name, signature)
+        agg_bit = self._agg_bit(self.round) if self.agg else None
+        if agg_bit is not None:
+            # Aggregate mode: a singleton-group partial (one group for
+            # this node's high_qc round) carrying the backing certificate.
+            sig = self.agg_signer.sign(
+                _timeout_digest(self.round, self.high_qc.round).data
+            )
+            timeout: Timeout | AggTimeoutBundle = AggTimeoutBundle(
+                self.round, self.high_qc,
+                ((self.high_qc.round, 1 << agg_bit),), sig,
+            )
+        else:
+            signature = await self.signature_service.request_signature(
+                _timeout_digest(self.round, self.high_qc.round)
+            )
+            timeout = Timeout(self.high_qc, self.round, self.name, signature)
         if self.timer is not None:
             # Exponential backoff (liveness only — timeouts carry no safety
             # weight): under overload, firing at a fixed cadence adds
@@ -527,23 +645,34 @@ class Core:
             )
             self.timer.set_delay_ms(max(delay, p.timeout_delay))
             self.timer.reset()
-        if self.overlay.enabled:
+        if isinstance(timeout, AggTimeoutBundle):
+            if self.overlay.enabled:
+                await self.overlay.on_own_timeout_agg(timeout)
+            else:
+                await self._transmit(timeout, None)
+                note_plane_frames(
+                    KIND_TIMEOUT,
+                    len(self.committee.broadcast_addresses(self.name)),
+                )
+            await self._handle_agg_timeout_bundle(timeout)
+        elif self.overlay.enabled:
             # Overlay mode: ONE bundle frame up the round's aggregation
             # tree (plus a bounded gossip fallback if the round stays
             # stalled) instead of an n-1 frame broadcast — the O(n²)
             # timeout-storm fix (consensus/overlay.py).
             await self.overlay.on_own_timeout(timeout)
+            await self._handle_timeout(timeout)
         else:
             await self._transmit(timeout, None)
             note_plane_frames(
                 KIND_TIMEOUT,
                 len(self.committee.broadcast_addresses(self.name)),
             )
-        await self._handle_timeout(timeout)
+            await self._handle_timeout(timeout)
 
     # -- proposals -----------------------------------------------------------
 
-    async def _generate_proposal(self, tc: TC | None) -> None:
+    async def _generate_proposal(self, tc: TC | AggTC | None) -> None:
         """Leader path (core.rs:278-318)."""
         if self.epochs.handoff_blocks(self.round):
             # Epoch-final wall, proposer side: nothing the old committee
@@ -631,6 +760,18 @@ class Core:
             )
         log.debug("created %s", vote)
         next_leader = self.leader_elector.get_leader(self.round + 1)
+        if isinstance(vote, AggVoteBundle):
+            if next_leader == self.name:
+                await self._handle_agg_vote_bundle(vote)
+            elif self.overlay.enabled:
+                await self.overlay.on_own_vote_agg(vote)
+            else:
+                await self._transmit(
+                    vote, next_leader,
+                    trace=self._trace_ctx(vote.round, vote.hash),
+                )
+                note_plane_frames(KIND_VOTE, 1)
+            return
         if next_leader == self.name:
             await self._handle_vote(vote)
         elif self.overlay.enabled:
@@ -755,7 +896,7 @@ class Core:
             if self.leader_elector.get_leader(self.round) == self.name:
                 await self._generate_proposal(None)
 
-    def _note_tc(self, tc: TC) -> None:
+    def _note_tc(self, tc: TC | AggTC) -> None:
         if self.last_tc is None or tc.round > self.last_tc.round:
             self.last_tc = tc
 
@@ -985,7 +1126,151 @@ class Core:
                 return
         await self.overlay.after_merge(key)
 
-    async def _handle_tc(self, tc: TC) -> None:
+    async def _handle_agg_vote_bundle(self, bundle: AggVoteBundle) -> None:
+        """Aggregate-certificate vote partial (§5.5o). Verification is
+        ATOMIC — the partial verifies as a whole or is rejected as a
+        whole (Handel's rule: an aggregate has no per-entry signatures to
+        salvage), so a forged member poisons only the partial carrying
+        it. Verified partials feed the Handel packing state: the next
+        leader's AggQCMaker when this node collects, the overlay partial
+        set (merge + forward one frame up the tree) otherwise."""
+        self.overlay.note_received()
+        if bundle.round < self.round:
+            return
+        committee = self.epochs.committee_for_round(bundle.round)
+        try:
+            members = _bitmap_members(bundle.bitmap, committee)
+            ensure(
+                bool(members),
+                InvalidSignatureError("empty aggregate vote partial"),
+            )
+            ok = aggsig.active_agg_scheme().verify(
+                _resolve_agg_keys(members),
+                bundle.signed_digest().data,
+                bundle.agg_sig,
+            )
+            ensure(
+                ok, InvalidSignatureError("aggregate vote partial rejected")
+            )
+        except ConsensusError:
+            _M_AGG_PARTIAL_REJECTS.inc()
+            self.overlay.note_invalid(1)
+            raise
+        if bundle.depth > self._agg_depth_max:
+            self._agg_depth_max = bundle.depth
+        if self.leader_elector.get_leader(bundle.round + 1) == self.name:
+            qc = self.agg_aggregator.add_vote_partial(bundle)
+            if qc is not None:
+                # NOTE: parsed by the benchmark LogParser (+ AGG:).
+                log.info(
+                    "Agg bundle quorum: QC round %s from %s entries",
+                    qc.round,
+                    qc.signers(),
+                )
+                await self._process_qc(qc)
+                if self.leader_elector.get_leader(self.round) == self.name:
+                    await self._generate_proposal(None)
+            return
+        key = OverlayRouter.vote_key(bundle.round, bundle.hash)
+        self.overlay.merge_agg_vote(
+            key, bundle.bitmap, bundle.agg_sig, bundle.depth
+        )
+        await self.overlay.after_merge(key)
+
+    async def _handle_agg_timeout_bundle(self, bundle: AggTimeoutBundle) -> None:
+        """Aggregate-certificate timeout partial. Atomicity REPLACES the
+        legacy filter_backed per-entry salvage: a bundle whose max
+        claimed high-qc round exceeds its carried certificate's round is
+        rejected WHOLE (an honest sender never produces one), the
+        carried certificate itself must verify, and the groups must be
+        bitmap-disjoint — only then does the one aggregate signature get
+        checked over the per-group timeout digests. Any node reaching
+        2f+1 packed stake assembles the AggTC and broadcasts it."""
+        self.overlay.note_received()
+        if bundle.round < self.round:
+            return
+        committee = self.epochs.committee_for_round(bundle.round)
+        try:
+            ensure(
+                bool(bundle.groups),
+                InvalidSignatureError("empty aggregate timeout partial"),
+            )
+            claimed = max(hqr for hqr, _ in bundle.groups)
+            ensure(
+                claimed <= bundle.high_qc.round,
+                InvalidSignatureError(
+                    "aggregate timeout partial claims an unbacked high-qc "
+                    f"round {claimed} > carried {bundle.high_qc.round}"
+                ),
+            )
+            if not bundle.high_qc.is_genesis():
+                await bundle.high_qc.verify_async(
+                    self.epochs, self.verification_service
+                )
+            seen = 0
+            groups = []
+            for hqr, bm in bundle.groups:
+                ensure(
+                    not bm & seen,
+                    InvalidSignatureError(
+                        "overlapping groups in aggregate timeout partial"
+                    ),
+                )
+                seen |= bm
+                members = _bitmap_members(bm, committee)
+                ensure(
+                    bool(members),
+                    InvalidSignatureError("empty aggregate timeout group"),
+                )
+                groups.append(
+                    (
+                        _resolve_agg_keys(members),
+                        _timeout_digest(bundle.round, hqr).data,
+                    )
+                )
+            ok = aggsig.active_agg_scheme().verify_groups(
+                groups, bundle.agg_sig
+            )
+            ensure(
+                ok, InvalidSignatureError("aggregate timeout partial rejected")
+            )
+        except ConsensusError:
+            _M_AGG_PARTIAL_REJECTS.inc()
+            self.overlay.note_invalid(1)
+            raise
+        if bundle.depth > self._agg_depth_max:
+            self._agg_depth_max = bundle.depth
+        if not bundle.high_qc.is_genesis():
+            await self._process_qc(bundle.high_qc)
+            if bundle.round < self.round:
+                return  # the carried certificate already outran this round
+        tc = self.agg_aggregator.add_timeout_partial(
+            bundle.round, bundle.groups, bundle.agg_sig, bundle.depth
+        )
+        if tc is not None:
+            # NOTE: parsed by the benchmark LogParser (+ AGG:).
+            log.info(
+                "Agg bundle quorum: TC round %s from %s entries",
+                tc.round,
+                tc.signers(),
+            )
+            self._note_tc(tc)
+            await self._advance_round(tc.round)
+            await self._transmit(tc, None)
+            if self.leader_elector.get_leader(self.round) == self.name:
+                await self._generate_proposal(tc)
+            return
+        key = OverlayRouter.timeout_key(bundle.round)
+        self.overlay.merge_agg_timeout(
+            key,
+            bundle.groups,
+            bundle.agg_sig,
+            bundle.depth,
+            carried_cert=bundle.high_qc,
+        )
+        await self.overlay.after_merge(key)
+
+    async def _handle_tc(self, tc: TC | AggTC) -> None:
         """A TC received directly (core.rs:438-444)."""
         await tc.verify_async(self.epochs, self.verification_service)
         self._note_tc(tc)
@@ -999,7 +1284,7 @@ class Core:
         if raw is None:
             return
         _M_SYNC_SERVED.inc()
-        block = Block.decode(Reader(raw))
+        block = decode_stored_block(raw)
         await self._transmit(block, request.requester, urgent=True)
 
     async def _handle_sync_range_request(self, request: SyncRangeRequest) -> None:
@@ -1196,7 +1481,11 @@ class Core:
                     await self._handle_vote_bundle(value)
                 elif isinstance(value, TimeoutBundle):
                     await self._handle_timeout_bundle(value)
-                elif isinstance(value, TC):
+                elif isinstance(value, AggVoteBundle):
+                    await self._handle_agg_vote_bundle(value)
+                elif isinstance(value, AggTimeoutBundle):
+                    await self._handle_agg_timeout_bundle(value)
+                elif isinstance(value, (TC, AggTC)):
                     await self._handle_tc(value)
                 elif isinstance(value, SyncRequest):
                     await self._handle_sync_request(value)

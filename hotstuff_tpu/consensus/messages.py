@@ -11,6 +11,18 @@ Every signed artifact commits to a domain-separated SHA-512/32 digest of its
 semantic content. A Vote signs the SAME digest a QC later verifies, so 2f+1
 Vote signatures aggregate directly into a QC whose batch verification is the
 TPU hot path (QC.verify -> Signature.verify_batch).
+
+Aggregate certificate plane (§5.5o): AggQC/AggTC are the constant-size
+forms — ONE aggregatable signature (crypto/aggsig seam) plus a fixed
+64-byte committee bitmap instead of a per-author entry list, signing the
+SAME `_vote_digest`/`_timeout_digest` preimages as the legacy forms, so
+the cert FORM is a transport choice and never a new trust domain. Bit i
+of a bitmap is member i of `_committee_at(committee, round).sorted_keys()`
+— epoch-resolved, so a bitmap is meaningless outside its own round's
+committee. Legacy entry-list forms still decode everywhere (mixed-fleet
+interop); aggregate-carrying frames ride NEW envelope tags, which old
+peers drop at `unknown consensus tag` — the same graceful-degradation
+path Ping/Pong established.
 """
 
 from __future__ import annotations
@@ -18,7 +30,7 @@ from __future__ import annotations
 import struct
 from dataclasses import dataclass, field
 
-from ..crypto import Digest, PublicKey, SecretKey, Signature, sha512_32
+from ..crypto import Digest, PublicKey, SecretKey, Signature, aggsig, sha512_32
 from ..utils.serde import Reader, SerdeError, Writer
 from .config import Committee
 from .errors import (
@@ -223,13 +235,217 @@ class TC:
         return f"TC(round {self.round}, {len(self.votes)} votes)"
 
 
+def _resolve_agg_keys(members: list[PublicKey]) -> list[bytes]:
+    """Committee identity -> aggregate public key, via the aggsig
+    registry (certificates carry no keys — that is the O(1) point). A
+    member without a registered aggregate key fails verification: the
+    registry is the proof-of-possession boundary."""
+    pks: list[bytes] = []
+    for member in members:
+        agg_pk = aggsig.agg_key_of(member.data)
+        ensure(
+            agg_pk is not None,
+            InvalidSignatureError(f"no aggregate key registered for {member}"),
+        )
+        pks.append(agg_pk)
+    return pks
+
+
+def _bitmap_members(bitmap: int, committee: Committee) -> list[PublicKey]:
+    try:
+        return aggsig.members_of(bitmap, committee.sorted_keys())
+    except ValueError as exc:
+        raise UnknownAuthorityError(f"aggregate bitmap: {exc}") from None
+
+
+def _encode_bitmap(w: Writer, bitmap: int) -> None:
+    w.fixed(aggsig.bitmap_to_bytes(bitmap), aggsig.AGG_BITMAP_BYTES)
+
+
+def _decode_bitmap(r: Reader) -> int:
+    return aggsig.bitmap_from_bytes(r.fixed(aggsig.AGG_BITMAP_BYTES))
+
+
+@dataclass(frozen=True, slots=True)
+class AggQC:
+    """Constant-size quorum certificate: ONE aggregate signature over
+    `_vote_digest(hash, round)` plus the bitmap of signing members.
+    Duck-type-compatible with QC everywhere the core reads certificates
+    (.hash/.round/.is_genesis()/check_quorum/verify) — genesis itself
+    stays the legacy QC.genesis() sentinel."""
+
+    hash: Digest
+    round: Round
+    bitmap: int
+    agg_sig: bytes
+
+    def is_genesis(self) -> bool:
+        return False
+
+    def signed_digest(self) -> Digest:
+        return _vote_digest(self.hash, self.round)
+
+    def signers(self) -> int:
+        return self.bitmap.bit_count()
+
+    def check_quorum(self, committee: Committee) -> None:
+        """Structural checks: bitmap within the round's committee,
+        2f+1 stake. Uniqueness is free — a bitmap cannot name a member
+        twice."""
+        committee = _committee_at(committee, self.round)
+        members = _bitmap_members(self.bitmap, committee)
+        weight = sum(committee.stake(m) for m in members)
+        ensure(weight >= committee.quorum_threshold(), QCRequiresQuorumError())
+
+    def verify(self, committee: Committee) -> None:
+        self.check_quorum(committee)
+        own = _committee_at(committee, self.round)
+        pks = _resolve_agg_keys(_bitmap_members(self.bitmap, own))
+        ok = aggsig.active_agg_scheme().verify(
+            pks, self.signed_digest().data, self.agg_sig
+        )
+        ensure(ok, InvalidSignatureError("aggregate QC verification failed"))
+
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
+        """Aggregate verification is ONE combine-and-compare (stub) or
+        one multi-pairing (exact) — there is no per-entry batch to
+        coalesce, so it runs inline rather than through the
+        verification service."""
+        self.verify(committee)
+
+    def encode(self, w: Writer) -> None:
+        w.fixed(self.hash.data, 32)
+        w.u64(self.round)
+        _encode_bitmap(w, self.bitmap)
+        w.var_bytes(self.agg_sig)
+
+    @staticmethod
+    def decode(r: Reader) -> "AggQC":
+        return AggQC(
+            Digest(r.fixed(32)), r.u64(), _decode_bitmap(r), r.var_bytes()
+        )
+
+    def __str__(self) -> str:
+        return f"AggQC(B{self.round}({self.hash.short()}), {self.signers()} signers)"
+
+
+@dataclass(frozen=True, slots=True)
+class AggTC:
+    """Constant-size timeout certificate: ONE aggregate signature
+    spanning one signing GROUP per distinct high-qc round (members in
+    group (hqr, bitmap) signed `_timeout_digest(round, hqr)`). Groups
+    must be bitmap-disjoint; quorum is their combined stake. Group
+    count is bounded by distinct hqr values among 2f+1 signers, so the
+    certificate is O(#distinct hqrs) — in practice a handful — never
+    O(n)."""
+
+    round: Round
+    groups: tuple[tuple[Round, int], ...]  # (high_qc_round, bitmap)
+    agg_sig: bytes
+
+    def high_qc_rounds(self) -> list[Round]:
+        return [hqr for hqr, _ in self.groups]
+
+    def signers(self) -> int:
+        return sum(bm.bit_count() for _, bm in self.groups)
+
+    def check_quorum(self, committee: Committee) -> None:
+        committee = _committee_at(committee, self.round)
+        weight = 0
+        seen = 0
+        for _, bm in self.groups:
+            overlap = bm & seen
+            if overlap:
+                idx = (overlap & -overlap).bit_length() - 1
+                raise AuthorityReuseError(committee.sorted_keys()[idx])
+            seen |= bm
+            weight += sum(
+                committee.stake(m) for m in _bitmap_members(bm, committee)
+            )
+        ensure(weight >= committee.quorum_threshold(), TCRequiresQuorumError())
+
+    def verify(self, committee: Committee) -> None:
+        self.check_quorum(committee)
+        own = _committee_at(committee, self.round)
+        groups = [
+            (
+                _resolve_agg_keys(_bitmap_members(bm, own)),
+                _timeout_digest(self.round, hqr).data,
+            )
+            for hqr, bm in self.groups
+        ]
+        ok = aggsig.active_agg_scheme().verify_groups(groups, self.agg_sig)
+        ensure(ok, InvalidSignatureError("aggregate TC verification failed"))
+
+    async def verify_async(
+        self, committee: Committee, service, trace: str | None = None
+    ) -> None:
+        self.verify(committee)
+
+    def encode(self, w: Writer) -> None:
+        w.u64(self.round)
+        w.seq(
+            list(self.groups),
+            lambda wr, g: (wr.u64(g[0]), _encode_bitmap(wr, g[1])),
+        )
+        w.var_bytes(self.agg_sig)
+
+    @staticmethod
+    def decode(r: Reader) -> "AggTC":
+        round_ = r.u64()
+        groups = tuple(r.seq(lambda rd: (rd.u64(), _decode_bitmap(rd))))
+        if len(groups) > aggsig.MAX_AGG_COMMITTEE:
+            raise SerdeError(f"aggregate TC over group cap: {len(groups)}")
+        return AggTC(round_, groups, r.var_bytes())
+
+    def __str__(self) -> str:
+        return (
+            f"AggTC(round {self.round}, {len(self.groups)} groups, "
+            f"{self.signers()} signers)"
+        )
+
+
+# Versioned certificate codec: aggregate-carrying containers (v2 blocks,
+# stored blobs, agg timeout bundles) prefix each certificate with one
+# version byte so either form round-trips.
+def encode_any_qc(w: Writer, qc) -> None:
+    if isinstance(qc, AggQC):
+        w.u8(1)
+    else:
+        w.u8(0)
+    qc.encode(w)
+
+
+def decode_any_qc(r: Reader):
+    return AggQC.decode(r) if r.u8() else QC.decode(r)
+
+
+def encode_any_tc(w: Writer, tc) -> None:
+    if isinstance(tc, AggTC):
+        w.u8(1)
+    else:
+        w.u8(0)
+    tc.encode(w)
+
+
+def decode_any_tc(r: Reader):
+    return AggTC.decode(r) if r.u8() else TC.decode(r)
+
+
 @dataclass(frozen=True, slots=True)
 class Block:
     """A proposal: orders payload DIGESTS only (32 B each); payload bytes are
-    disseminated by the mempool plane (consensus/src/messages.rs:22-117)."""
+    disseminated by the mempool plane (consensus/src/messages.rs:22-117).
 
-    qc: QC
-    tc: TC | None
+    Certificates may be legacy (QC/TC) or aggregate (AggQC/AggTC) forms;
+    the block DIGEST commits to (qc.hash, qc.round) only, so it is
+    independent of the certificate form — certificates are self-verifying
+    and the form is a transport choice (module docstring)."""
+
+    qc: QC | AggQC
+    tc: TC | AggTC | None
     author: PublicKey
     round: Round
     payload: tuple[Digest, ...]
@@ -282,7 +498,7 @@ class Block:
         author: PublicKey,
         round_: Round,
         payload: list[Digest],
-        qc: QC,
+        qc: QC | AggQC,
         reconfig: EpochChange | None = None,
     ) -> Digest:
         h = b"HSBLOCK" + author.data + struct.pack("<Q", round_)
@@ -353,13 +569,19 @@ class Block:
         msgs: list[bytes] = [self.digest().data]
         pairs: list[tuple[PublicKey, Signature]] = [(self.author, self.signature)]
         qc_lo = qc_hi = tc_lo = tc_hi = len(msgs)
-        if not self.qc.is_genesis():
+        if isinstance(self.qc, AggQC):
+            # One combine-and-compare (or one multi-pairing): no entry
+            # batch to coalesce through the service — verified inline.
+            self.qc.verify(committee)
+        elif not self.qc.is_genesis():
             self.qc.check_quorum(committee)
             m, p = self.qc.signed_items()
             qc_lo, qc_hi = len(msgs), len(msgs) + len(m)
             msgs += m
             pairs += p
-        if self.tc is not None:
+        if isinstance(self.tc, AggTC):
+            self.tc.verify(committee)
+        elif self.tc is not None:
             self.tc.check_quorum(committee)
             m, p = self.tc.signed_items()
             tc_lo, tc_hi = len(msgs), len(msgs) + len(m)
@@ -394,7 +616,18 @@ class Block:
             InvalidSignatureError(f"bad epoch-change signature B{self.round}"),
         )
 
+    def has_agg_certs(self) -> bool:
+        return isinstance(self.qc, AggQC) or isinstance(self.tc, AggTC)
+
     def encode(self, w: Writer) -> None:
+        """LEGACY wire layout — byte-identical to every committed
+        artifact. Blocks carrying aggregate certificates must use
+        encode_v2 (the envelope and store helpers route on
+        has_agg_certs)."""
+        if self.has_agg_certs():
+            raise TypeError(
+                "aggregate-certificate block needs the v2 encoding"
+            )
         self.qc.encode(w)
         if self.tc is None:
             w.u8(0)
@@ -422,13 +655,89 @@ class Block:
         reconfig = EpochChange.decode(r) if r.u8() else None
         return Block(qc, tc, author, round_, payload, sig, reconfig)
 
+    def encode_v2(self, w: Writer) -> None:
+        """Same field order as the legacy layout with each certificate
+        behind a one-byte version prefix (encode_any_qc/tc) — the form
+        aggregate-carrying frames and store blobs use."""
+        encode_any_qc(w, self.qc)
+        if self.tc is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            encode_any_tc(w, self.tc)
+        w.fixed(self.author.data, 32)
+        w.u64(self.round)
+        w.seq(list(self.payload), lambda wr, d: wr.fixed(d.data, 32))
+        w.fixed(self.signature.data, 64)
+        if self.reconfig is None:
+            w.u8(0)
+        else:
+            w.u8(1)
+            self.reconfig.encode(w)
+
+    @staticmethod
+    def decode_v2(r: Reader) -> "Block":
+        qc = decode_any_qc(r)
+        tc = decode_any_tc(r) if r.u8() else None
+        author = PublicKey(r.fixed(32))
+        round_ = r.u64()
+        payload = tuple(r.seq(lambda rd: Digest(rd.fixed(32))))
+        sig = Signature(r.fixed(64))
+        reconfig = EpochChange.decode(r) if r.u8() else None
+        return Block(qc, tc, author, round_, payload, sig, reconfig)
+
     def size(self) -> int:
         w = Writer()
-        self.encode(w)
+        if self.has_agg_certs():
+            self.encode_v2(w)
+        else:
+            self.encode(w)
+        return len(w.bytes())
+
+    def certificate_bytes(self) -> int:
+        """Encoded size of the certificates this block carries (QC plus
+        TC if any) — the quantity the `bytes_per_committed_round` matrix
+        column accounts per commit. Uses each certificate's own wire
+        encoding, so legacy forms report O(96·quorum) and aggregate
+        forms report a committee-size-independent constant."""
+        w = Writer()
+        self.qc.encode(w)
+        if self.tc is not None:
+            self.tc.encode(w)
         return len(w.bytes())
 
     def __str__(self) -> str:
         return f"B{self.round}({self.digest().short()})"
+
+
+def _encode_any_block(w: Writer, block: Block) -> None:
+    if block.has_agg_certs():
+        w.u8(1)
+        block.encode_v2(w)
+    else:
+        w.u8(0)
+        block.encode(w)
+
+
+def _decode_any_block(r: Reader) -> Block:
+    return Block.decode_v2(r) if r.u8() else Block.decode(r)
+
+
+def encode_stored_block(block: Block) -> bytes:
+    """Store-blob form: one version byte then the matching block layout.
+    Every store read/write goes through this pair so a store can hold
+    legacy and aggregate-certificate blocks side by side (stores are
+    per-run; no cross-version migration concern)."""
+    w = Writer()
+    _encode_any_block(w, block)
+    return w.bytes()
+
+
+def decode_stored_block(data: bytes) -> Block:
+    r = Reader(data)
+    block = _decode_any_block(r)
+    r.expect_done()
+    return block
 
 
 @dataclass(frozen=True, slots=True)
@@ -569,6 +878,15 @@ TAG_TIMEOUT_BUNDLE = 8
 # and drops the frame — the graceful-degradation path for mixed fleets.
 TAG_PING = 9
 TAG_PONG = 10
+# Aggregate certificate plane (§5.5o): only frames that actually carry
+# an aggregate form use these tags — a mixed fleet keeps full interop on
+# the legacy tags, and aggregate frames degrade at old peers exactly
+# like Ping/Pong (unknown tag, one decode_errors count, frame dropped).
+TAG_PROPOSE_V2 = 11
+TAG_AGG_VOTE_BUNDLE = 12
+TAG_AGG_TIMEOUT_BUNDLE = 13
+TAG_AGG_TC = 14
+TAG_SYNC_RANGE_REPLY_V2 = 15
 
 # Defensive cap on entries per partial bundle: an unauthenticated peer
 # must not make a receiver decode (and batch-verify) an unbounded entry
@@ -579,8 +897,12 @@ MAX_BUNDLE_ENTRIES = 4096
 def encode_consensus_message(msg) -> bytes:
     w = Writer()
     if isinstance(msg, Block):
-        w.u8(TAG_PROPOSE)
-        msg.encode(w)
+        if msg.has_agg_certs():
+            w.u8(TAG_PROPOSE_V2)
+            msg.encode_v2(w)
+        else:
+            w.u8(TAG_PROPOSE)
+            msg.encode(w)
     elif isinstance(msg, Vote):
         w.u8(TAG_VOTE)
         msg.encode(w)
@@ -589,6 +911,9 @@ def encode_consensus_message(msg) -> bytes:
         msg.encode(w)
     elif isinstance(msg, TC):
         w.u8(TAG_TC)
+        msg.encode(w)
+    elif isinstance(msg, AggTC):
+        w.u8(TAG_AGG_TC)
         msg.encode(w)
     elif isinstance(msg, SyncRequest):
         w.u8(TAG_SYNC_REQUEST)
@@ -602,9 +927,14 @@ def encode_consensus_message(msg) -> bytes:
     elif isinstance(msg, SyncRangeReply):
         if len(msg.blocks) > MAX_RANGE_BATCH:
             raise ValueError(f"range reply over batch cap: {len(msg.blocks)}")
-        w.u8(TAG_SYNC_RANGE_REPLY)
-        w.fixed(msg.target.data, 32)
-        w.seq(list(msg.blocks), lambda wr, b: b.encode(wr))
+        if any(b.has_agg_certs() for b in msg.blocks):
+            w.u8(TAG_SYNC_RANGE_REPLY_V2)
+            w.fixed(msg.target.data, 32)
+            w.seq(list(msg.blocks), _encode_any_block)
+        else:
+            w.u8(TAG_SYNC_RANGE_REPLY)
+            w.fixed(msg.target.data, 32)
+            w.seq(list(msg.blocks), lambda wr, b: b.encode(wr))
     elif isinstance(msg, VoteBundle):
         if len(msg.votes) > MAX_BUNDLE_ENTRIES:
             raise ValueError(f"vote bundle over entry cap: {len(msg.votes)}")
@@ -628,6 +958,23 @@ def encode_consensus_message(msg) -> bytes:
                 wr.u64(v[2]),
             ),
         )
+    elif isinstance(msg, AggVoteBundle):
+        w.u8(TAG_AGG_VOTE_BUNDLE)
+        w.u64(msg.round)
+        w.fixed(msg.hash.data, 32)
+        _encode_bitmap(w, msg.bitmap)
+        w.var_bytes(msg.agg_sig)
+        w.u8(min(msg.depth, 255))
+    elif isinstance(msg, AggTimeoutBundle):
+        w.u8(TAG_AGG_TIMEOUT_BUNDLE)
+        w.u64(msg.round)
+        encode_any_qc(w, msg.high_qc)
+        w.seq(
+            list(msg.groups),
+            lambda wr, g: (wr.u64(g[0]), _encode_bitmap(wr, g[1])),
+        )
+        w.var_bytes(msg.agg_sig)
+        w.u8(min(msg.depth, 255))
     elif isinstance(msg, Ping):
         w.u8(TAG_PING)
         w.fixed(msg.origin.data, 32)
@@ -692,6 +1039,30 @@ def decode_consensus_message(data: bytes):
         if len(timeouts) > MAX_BUNDLE_ENTRIES:
             raise SerdeError(f"timeout bundle over entry cap: {len(timeouts)}")
         out = TimeoutBundle(round_, high_qc, timeouts)
+    elif tag == TAG_PROPOSE_V2:
+        out = Block.decode_v2(r)
+    elif tag == TAG_AGG_TC:
+        out = AggTC.decode(r)
+    elif tag == TAG_SYNC_RANGE_REPLY_V2:
+        target = Digest(r.fixed(32))
+        blocks = tuple(r.seq(_decode_any_block))
+        if len(blocks) > MAX_RANGE_BATCH:
+            raise SerdeError(f"range reply over batch cap: {len(blocks)}")
+        out = SyncRangeReply(target, blocks)
+    elif tag == TAG_AGG_VOTE_BUNDLE:
+        out = AggVoteBundle(
+            r.u64(), Digest(r.fixed(32)), _decode_bitmap(r),
+            r.var_bytes(), r.u8(),
+        )
+    elif tag == TAG_AGG_TIMEOUT_BUNDLE:
+        round_ = r.u64()
+        high_qc = decode_any_qc(r)
+        groups = tuple(r.seq(lambda rd: (rd.u64(), _decode_bitmap(rd))))
+        if len(groups) > aggsig.MAX_AGG_COMMITTEE:
+            raise SerdeError(
+                f"aggregate timeout bundle over group cap: {len(groups)}"
+            )
+        out = AggTimeoutBundle(round_, high_qc, groups, r.var_bytes(), r.u8())
     elif tag == TAG_PING:
         out = Ping(PublicKey(r.fixed(32)), r.u64(), r.u64())
     elif tag == TAG_PONG:
@@ -775,6 +1146,67 @@ class TimeoutBundle:
         return (
             f"TB{self.round}(high_qc round {self.high_qc.round}, "
             f"{len(self.timeouts)} timeouts)"
+        )
+
+
+@dataclass(frozen=True, slots=True)
+class AggVoteBundle:
+    """Handel-style PARTIAL aggregate for one (round, block digest): an
+    aggregate signature over `_vote_digest(hash, round)` covering the
+    bitmap's members. A single node's vote is the singleton-bitmap case;
+    interior overlay nodes merge bitmap-DISJOINT partials by one
+    combine() plus a bitmap OR — gossip carries aggregates, never entry
+    lists. Verification is ATOMIC: the partial verifies as a whole or is
+    dropped as a whole (there is no per-entry salvage in an aggregate —
+    Handel's atomic-partial rule), so a forged member poisons only the
+    partial it rides in, and only until the sender's next window.
+    `depth` is telemetry-only (merge-tree height for the CERTS scrape):
+    it never participates in verification."""
+
+    round: Round
+    hash: Digest
+    bitmap: int
+    agg_sig: bytes
+    depth: int = 0
+
+    def signed_digest(self) -> Digest:
+        return _vote_digest(self.hash, self.round)
+
+    def signers(self) -> int:
+        return self.bitmap.bit_count()
+
+    def __str__(self) -> str:
+        return (
+            f"AVB{self.round}({self.hash.short()}, {self.signers()} signers, "
+            f"depth {self.depth})"
+        )
+
+
+@dataclass(frozen=True, slots=True)
+class AggTimeoutBundle:
+    """Handel-style partial aggregate for one timed-out round: one
+    aggregate signature spanning `groups` (one (high_qc_round, bitmap)
+    group per distinct claimed hqr, AggTC-shaped), plus the highest QC
+    the contributing members could back their claims with. Atomicity
+    replaces the legacy `filter_backed` per-entry salvage: a bundle
+    whose max claimed hqr exceeds its carried certificate's round is
+    rejected WHOLE (an honest sender never produces one), so the
+    TC-poisoning guard holds without per-entry signatures to fall back
+    on."""
+
+    round: Round
+    high_qc: QC | AggQC
+    groups: tuple[tuple[Round, int], ...]
+    agg_sig: bytes
+    depth: int = 0
+
+    def signers(self) -> int:
+        return sum(bm.bit_count() for _, bm in self.groups)
+
+    def __str__(self) -> str:
+        return (
+            f"ATB{self.round}(high_qc round {self.high_qc.round}, "
+            f"{len(self.groups)} groups, {self.signers()} signers)"
         )
 
 

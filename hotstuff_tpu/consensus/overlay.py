@@ -62,10 +62,18 @@ import asyncio
 import logging
 import struct
 
-from ..crypto import Digest, PublicKey, sha512_32
+from ..crypto import Digest, PublicKey, aggsig, sha512_32
 from ..utils import metrics, tracing
 from ..utils.actors import spawn
-from .messages import QC, Round, TimeoutBundle, VoteBundle
+from .aggregator import AggPartialSet, _merge_timeout_payload
+from .messages import (
+    QC,
+    AggTimeoutBundle,
+    AggVoteBundle,
+    Round,
+    TimeoutBundle,
+    VoteBundle,
+)
 
 log = logging.getLogger("hotstuff.consensus")
 
@@ -223,18 +231,24 @@ class AggregationTree:
 
 
 class _Pending:
-    """Merge state for one (round, kind[, digest]) key."""
+    """Merge state for one (round, kind[, digest]) key. Legacy mode
+    accumulates per-author entries; aggregate mode (Parameters.
+    aggregate_certs) accumulates bitmap-disjoint partials in a Handel
+    AggPartialSet instead — `agg_set` is created on first aggregate
+    merge and the two never mix under one key."""
 
     __slots__ = (
         "entries", "best_qc", "forwards", "hold_task", "fallback_task",
+        "agg_set",
     )
 
     def __init__(self) -> None:
         self.entries: dict[PublicKey, tuple] = {}
-        self.best_qc: QC | None = None
+        self.best_qc: QC | None = None  # best carried cert (QC or AggQC)
         self.forwards = 0
         self.hold_task: asyncio.Task | None = None
         self.fallback_task: asyncio.Task | None = None
+        self.agg_set: AggPartialSet | None = None
 
     def cancel_hold(self) -> None:
         if self.hold_task is not None and not self.hold_task.done():
@@ -267,6 +281,10 @@ class OverlayRouter:
         self.hold_s = p.agg_hold_ms / 1000.0
         self.fallback_s = p.agg_fallback_ms / 1000.0
         self.max_forwards = p.agg_max_forwards
+        # Aggregate-certificate mode: partials are one signature + bitmap
+        # and interior merges are combine()+OR — never entry lists.
+        self.agg = bool(p.aggregate_certs)
+        self.window = p.agg_window
         self._trees: dict[tuple[Round, int], AggregationTree] = {}
         self._state: dict[tuple, _Pending] = {}
 
@@ -350,10 +368,76 @@ class OverlayRouter:
         if n > 0:
             _M_INVALID.inc(n)
 
+    # -- aggregate merges (Parameters.aggregate_certs) -----------------------
+
+    def merge_agg_vote(
+        self, key: tuple, bitmap: int, agg_sig: bytes, depth: int
+    ) -> None:
+        """Merge one VERIFIED vote partial: Handel windowed insert —
+        combine() + bitmap OR against every disjoint entry."""
+        st = self._pending(key)
+        if st.agg_set is None:
+            st.agg_set = AggPartialSet(
+                aggsig.active_agg_scheme().combine, self.window
+            )
+        st.agg_set.add(bitmap, agg_sig, depth)
+        _M_ENTRIES_MERGED.inc(bitmap.bit_count())
+
+    def merge_agg_timeout(
+        self,
+        key: tuple,
+        groups: tuple[tuple[Round, int], ...],
+        agg_sig: bytes,
+        depth: int,
+        carried_cert=None,
+    ) -> None:
+        """Merge one VERIFIED timeout partial. Keeps the highest-round
+        carried certificate: every accepted partial's claims were backed
+        by its own carried cert, so the max over contributors backs the
+        merged bundle's claims too (the atomic analogue of
+        filter_backed's invariant)."""
+        st = self._pending(key)
+        if st.agg_set is None:
+            st.agg_set = AggPartialSet(_merge_timeout_payload, self.window)
+        coverage = 0
+        for _, bm in groups:
+            coverage |= bm
+        st.agg_set.add(
+            coverage,
+            (tuple(sorted(groups)), agg_sig, aggsig.active_agg_scheme()),
+            depth,
+        )
+        _M_ENTRIES_MERGED.inc(coverage.bit_count())
+        if carried_cert is not None and not carried_cert.is_genesis():
+            if st.best_qc is None or carried_cert.round > st.best_qc.round:
+                st.best_qc = carried_cert
+
+    def covered(self, key: tuple) -> int:
+        """Members this key's merged state covers — entry count in legacy
+        mode, best-packing popcount in aggregate mode (the forward-policy
+        quantity)."""
+        st = self._pending(key)
+        if st.agg_set is not None:
+            best = st.agg_set.best()
+            return best[0].bit_count() if best else 0
+        return len(st.entries)
+
     # -- egress --------------------------------------------------------------
 
     def _bundle(self, key: tuple):
         st = self._pending(key)
+        if st.agg_set is not None:
+            best = st.agg_set.best()
+            if best is None:
+                return None
+            if key[0] == KIND_VOTE:
+                bitmap, sig, depth = best
+                return AggVoteBundle(key[1], key[2], bitmap, sig, depth)
+            _, payload, depth = best
+            groups, sig, _ = payload
+            return AggTimeoutBundle(
+                key[1], st.best_qc or QC.genesis(), groups, sig, depth
+            )
         entries = tuple(st.entries.values())
         if key[0] == KIND_VOTE:
             return VoteBundle(key[1], key[2], entries)
@@ -361,7 +445,7 @@ class OverlayRouter:
 
     async def _send(self, key: tuple, to: PublicKey, urgent: bool) -> None:
         bundle = self._bundle(key)
-        if not bundle_entries(bundle):
+        if bundle is None or not bundle_weight(bundle):
             return
         _M_BUNDLES_SENT.inc()
         note_plane_frames(key[0], 1)
@@ -372,7 +456,7 @@ class OverlayRouter:
             {
                 "round": key[1],
                 "kind": "vote" if key[0] == KIND_VOTE else "timeout",
-                "entries": len(bundle_entries(bundle)),
+                "entries": bundle_weight(bundle),
             },
         )
         await self.core._transmit(bundle, to, urgent=urgent)
@@ -395,6 +479,22 @@ class OverlayRouter:
         self._arm_fallback(key)
         await self.after_merge(key)
 
+    async def on_own_vote_agg(self, bundle: AggVoteBundle) -> None:
+        """This node's own singleton vote partial enters the tree."""
+        key = self.vote_key(bundle.round, bundle.hash)
+        self.merge_agg_vote(key, bundle.bitmap, bundle.agg_sig, bundle.depth)
+        self._arm_fallback(key)
+        await self.after_merge(key)
+
+    async def on_own_timeout_agg(self, bundle: AggTimeoutBundle) -> None:
+        key = self.timeout_key(bundle.round)
+        self.merge_agg_timeout(
+            key, bundle.groups, bundle.agg_sig, bundle.depth,
+            carried_cert=bundle.high_qc,
+        )
+        self._arm_fallback(key)
+        await self.after_merge(key)
+
     async def after_merge(self, key: tuple) -> None:
         """Forward policy after any merge: ship immediately once this
         node's whole subtree is covered (nothing left to wait for), else
@@ -410,7 +510,7 @@ class OverlayRouter:
         tree = self.tree(round_, key[0])
         if tree.parent(self.core.name) is None:
             return  # collector: the core's aggregator is the sink
-        if len(st.entries) >= tree.subtree_size(self.core.name):
+        if self.covered(key) >= tree.subtree_size(self.core.name):
             st.cancel_hold()
             await self._forward(key)
         elif st.hold_task is None or st.hold_task.done():
@@ -468,20 +568,23 @@ class OverlayRouter:
         _M_FALLBACKS.inc()
         note_plane_frames(key[0], len(peers))
         _M_BUNDLES_SENT.inc(len(peers))
+        covered = self.covered(key)
         tracing.RECORDER.record(
             "agg.fallback",
             None,
             None,
-            {"round": key[1], "peers": len(peers), "entries": len(st.entries)},
+            {"round": key[1], "peers": len(peers), "entries": covered},
         )
         # NOTE: parsed by the benchmark LogParser (+ AGG section).
         log.info(
             "Agg fallback round %s: %s entries to %s peers",
             key[1],
-            len(st.entries),
+            covered,
             len(peers),
         )
         bundle = self._bundle(key)
+        if bundle is None:
+            return
         for peer in peers:
             await self.core._transmit(bundle, peer, urgent=key[0] == KIND_TIMEOUT)
 
@@ -502,6 +605,14 @@ class OverlayRouter:
 def bundle_entries(bundle) -> tuple:
     """The entry tuple of either bundle kind (votes or timeouts)."""
     return bundle.votes if isinstance(bundle, VoteBundle) else bundle.timeouts
+
+
+def bundle_weight(bundle) -> int:
+    """Members a bundle speaks for: entry count for legacy bundles,
+    bitmap popcount for aggregate partials."""
+    if isinstance(bundle, (AggVoteBundle, AggTimeoutBundle)):
+        return bundle.signers()
+    return len(bundle_entries(bundle))
 
 
 def filter_backed(entries, backed_round: Round) -> tuple[list, int]:

@@ -60,6 +60,7 @@ from .messages import (
     Round,
     SyncRangeRequest,
     SyncRequest,
+    decode_stored_block,
     encode_consensus_message,
 )
 from .reconfig import as_manager
@@ -120,7 +121,7 @@ async def collect_range(
             if not chain:
                 return []  # unknown target: nothing to serve
             break
-        block = Block.decode(Reader(raw))
+        block = decode_stored_block(raw)
         if block.round <= from_round:
             break
         chain.append(block)
@@ -190,7 +191,7 @@ class Synchronizer:
         parent = block.parent()
         raw = await self.store.read(parent.data)
         if raw is not None:
-            return Block.decode(Reader(raw))
+            return decode_stored_block(raw)
         await self._register(parent, block, reverify=False)
         return None
 

@@ -19,6 +19,7 @@ from . import pipeline, timeline  # dependency-free; eager on purpose
 __all__ = [
     "field",
     "ed25519",
+    "bls",
     "pipeline",
     "timeline",
     "Ed25519TpuVerifier",
@@ -30,7 +31,7 @@ __all__ = [
 
 # Package attributes resolved lazily so `import hotstuff_tpu.ops` (and the
 # timeline/telemetry modules) never pull jax.
-_LAZY_MODULES = ("field", "field12", "ed25519", "sha512", "pallas_ladder")
+_LAZY_MODULES = ("field", "field12", "ed25519", "sha512", "pallas_ladder", "bls")
 _LAZY_ED25519 = ("Ed25519TpuVerifier", "prepare_batch", "prepare_batch_packed")
 
 

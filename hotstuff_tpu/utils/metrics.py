@@ -517,6 +517,13 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("verifier.dedup_evictions", "counter", None),
     ("verifier.rejected_sigs", "counter", None),
     ("verifier.committee_rejected_sigs", "counter", None),
+    # ops/bls.py — batched G1 public-key aggregation kernel (§5.5o).
+    # host_fallbacks counts CommitteeTable aggregations that ran the exact
+    # pure-python fold because jax was unavailable on the host.
+    ("bls.table_builds", "counter", None),
+    ("bls.aggregations", "counter", None),
+    ("bls.points_aggregated", "counter", None),
+    ("bls.host_fallbacks", "counter", None),
     ("crypto.tpu_batches", "counter", None),
     ("crypto.tpu_sigs", "counter", None),
     ("crypto.cpu_batches", "counter", None),
@@ -597,6 +604,15 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("agg.fallbacks", "counter", None),
     ("agg.vote_frames", "counter", None),
     ("agg.timeout_frames", "counter", None),
+    # consensus/aggregator.py + core.py — constant-size certificate plane
+    # (§5.5o). cert_bytes_committed counts wire bytes of EVERY committed
+    # QC/TC (aggregate or entry-list, any crypto mode) so the fleet_rollup
+    # bytes_per_committed_round column is mode-comparable across cells.
+    ("agg.qcs_formed", "counter", None),
+    ("agg.tcs_formed", "counter", None),
+    ("agg.partials_merged", "counter", None),
+    ("agg.partial_rejects", "counter", None),
+    ("agg.cert_bytes_committed", "counter", None),
     ("consensus.round", "gauge", None),
     ("consensus.proposal_to_vote_s", "histogram", None),
     ("consensus.qc_form_s", "histogram", None),
@@ -667,6 +683,11 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("chaos.stub_signs", "counter", None),
     ("chaos.stub_verifies", "counter", None),
     ("chaos.stub_rejects", "counter", None),
+    # chaos/trusted_crypto.py — aggregate analogue of the stub scheme
+    # (TrustedAggScheme): XOR-combine partials, byte-exact recompute verify
+    ("chaos.stub_agg_signs", "counter", None),
+    ("chaos.stub_agg_verifies", "counter", None),
+    ("chaos.stub_agg_rejects", "counter", None),
     # chaos/plan.py WanMatrix via chaos/transport.py — per-region RTT classes
     ("wan.frames", "counter", None),
     ("wan.cross_region_frames", "counter", None),

@@ -922,6 +922,22 @@ def fleet_rollup(report: dict) -> dict:
             "rate_per_s": round(total_commits / span, 3) if span > 0 else 0.0,
             "min_node": min(per_node_commits.values(), default=0),
             "max_node": max(per_node_commits.values(), default=0),
+            # Certificate-plane payoff column (§5.5o): certificate bytes
+            # per committed round, averaged fleet-wide. Both terms scale
+            # with n, so a flat value across n = 4..128 is the O(1)
+            # constant-size-certificate claim in one number; entry-list
+            # fleets grow linearly here. The counter is maintained in
+            # every crypto mode, so legacy and aggregate cells compare;
+            # None = the report predates the counter (not "0 bytes").
+            "bytes_per_committed_round": (
+                round(
+                    float(metrics_delta["agg.cert_bytes_committed"])
+                    / total_commits,
+                    1,
+                )
+                if total_commits and "agg.cert_bytes_committed" in metrics_delta
+                else None
+            ),
         },
         "lanes": merge_lane_summaries(lane_src),
         "occupancy": {

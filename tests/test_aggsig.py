@@ -1,0 +1,314 @@
+"""Aggregate-signature plane units (§5.5o): committee bitmaps, the
+Handel partial set, AggQC/AggTC wire forms and verification through the
+scheme seam, epoch-boundary committee resolution, and ONE exact BLS12-381
+round-trip pinning the pure-python curve against a forged partial.
+
+Dependency-free (no `cryptography`, no jax): committee identities come
+from pysigner, aggregate signatures from the trusted-agg stub — except
+the exact-curve test, which is pure ints. Exact pairings cost ~10 s each
+on this class of host, so the exact test performs exactly two verifies;
+everything structural runs on the stub."""
+
+from __future__ import annotations
+
+import pytest
+
+from hotstuff_tpu.chaos.trusted_crypto import TrustedAggScheme
+from hotstuff_tpu.consensus import Committee
+from hotstuff_tpu.consensus.aggregator import AggCertAggregator, AggPartialSet
+from hotstuff_tpu.consensus.errors import (
+    InvalidSignatureError,
+    QCRequiresQuorumError,
+    UnknownAuthorityError,
+)
+from hotstuff_tpu.consensus.messages import (
+    QC,
+    AggQC,
+    AggTC,
+    AggVoteBundle,
+    decode_any_qc,
+    decode_any_tc,
+    encode_any_qc,
+    encode_any_tc,
+    _timeout_digest,
+    _vote_digest,
+)
+from hotstuff_tpu.crypto import Digest, PublicKey, aggsig, pysigner
+from hotstuff_tpu.utils.serde import Reader, Writer
+
+
+def _fleet(n: int, tag: bytes = b"agg", epoch: int = 1):
+    """n (identity PublicKey, seed) pairs in sorted-key order plus their
+    Committee — the orchestrator's key ceremony, minus the network."""
+    pairs = [
+        pysigner.keypair_from_seed(tag + bytes(31 - len(tag)) + bytes([i]))
+        for i in range(n)
+    ]
+    pairs.sort(key=lambda kp: kp[0])
+    keys = [(PublicKey(pk), seed) for pk, seed in pairs]
+    cmt = Committee.new(
+        [(pk, 1, ("127.0.0.1", 7000 + i)) for i, (pk, _) in enumerate(keys)],
+        epoch=epoch,
+    )
+    return keys, cmt
+
+
+def _install_stub(keys):
+    """Install the trusted-agg scheme + identity->agg-pk registry for
+    `keys`; returns (scheme, restore-thunk)."""
+    scheme = TrustedAggScheme()
+    prev_scheme = aggsig.install_agg_scheme(scheme)
+    prev_reg = aggsig.install_agg_registry(
+        {pk.data: scheme.keypair_from_seed(seed)[0] for pk, seed in keys}
+    )
+
+    def restore():
+        aggsig.install_agg_scheme(prev_scheme)
+        aggsig.install_agg_registry(prev_reg)
+
+    return scheme, restore
+
+
+def _agg_qc(keys, cmt, scheme, round_=3, signer_idx=None):
+    """AggQC over a synthetic digest signed by `signer_idx` members."""
+    digest = Digest.of(b"block-under-test")
+    msg = _vote_digest(digest, round_).data
+    idx = list(range(len(keys))) if signer_idx is None else list(signer_idx)
+    sigs = [scheme.sign(keys[i][1], msg) for i in idx]
+    bitmap = aggsig.bitmap_of(
+        [keys[i][0] for i in idx], cmt.sorted_keys()
+    )
+    return AggQC(digest, round_, bitmap, scheme.aggregate(sigs))
+
+
+# --- bitmaps ----------------------------------------------------------------
+
+
+def test_bitmap_roundtrip_and_bounds():
+    keys, cmt = _fleet(5)
+    sorted_keys = cmt.sorted_keys()
+    members = [sorted_keys[0], sorted_keys[2], sorted_keys[4]]
+    bm = aggsig.bitmap_of(members, sorted_keys)
+    assert bm == 0b10101
+    assert aggsig.members_of(bm, sorted_keys) == members
+    # wire form: fixed 64 bytes regardless of committee size
+    data = aggsig.bitmap_to_bytes(bm)
+    assert len(data) == aggsig.AGG_BITMAP_BYTES
+    assert aggsig.bitmap_from_bytes(data) == bm
+    # a bit beyond the committee is a malformed / wrong-epoch bitmap
+    with pytest.raises(ValueError):
+        aggsig.members_of(1 << 5, sorted_keys)
+
+
+# --- trusted-agg stub: round-trip + forged-partial rejection ----------------
+
+
+def test_stub_aggregate_roundtrip_and_rejections():
+    keys, _ = _fleet(4)
+    scheme = TrustedAggScheme()
+    msg = b"round-trip message"
+    pks = [scheme.keypair_from_seed(seed)[0] for _, seed in keys]
+    sigs = [scheme.sign(seed, msg) for _, seed in keys]
+    agg = scheme.aggregate(sigs)
+    assert scheme.verify(pks, msg, agg)
+    # order-independence: Handel merges partials on arbitrary paths
+    assert scheme.aggregate(reversed(sigs)) == agg
+    # bitmap<->committee binding: claiming a different member set fails
+    assert not scheme.verify(pks[:3], msg, agg)
+    assert not scheme.verify(pks[:3] + [pks[0]], msg, agg)
+    # forged partial: an outsider's signature poisons the whole aggregate
+    outsider = TrustedAggScheme().keypair_from_seed(bytes(32))[1]
+    forged = scheme.aggregate(sigs[:3] + [scheme.sign(outsider, msg)])
+    assert not scheme.verify(pks, msg, forged)
+    # tampered aggregate / wrong message
+    assert not scheme.verify(pks, msg, agg[:-1] + bytes([agg[-1] ^ 1]))
+    assert not scheme.verify(pks, msg + b"!", agg)
+
+
+# --- AggQC/AggTC wire forms + legacy interop --------------------------------
+
+
+def test_agg_cert_wire_roundtrip_constant_size():
+    keys, cmt = _fleet(4)
+    scheme, restore = _install_stub(keys)
+    try:
+        sizes = []
+        for idx in ([0, 1, 2], [0, 1, 2, 3]):
+            qc = _agg_qc(keys, cmt, scheme, signer_idx=idx)
+            w = Writer()
+            encode_any_qc(w, qc)
+            blob = w.bytes()
+            sizes.append(len(blob))
+            assert decode_any_qc(Reader(blob)) == qc
+        # the O(1) point: adding a signer does not grow the certificate
+        assert sizes[0] == sizes[1]
+
+        msg = _timeout_digest(9, 4).data
+        groups = (
+            (4, aggsig.bitmap_of([k for k, _ in keys[:3]], cmt.sorted_keys())),
+        )
+        tc = AggTC(
+            9, groups, scheme.aggregate(
+                [scheme.sign(s, msg) for _, s in keys[:3]]
+            )
+        )
+        w = Writer()
+        encode_any_tc(w, tc)
+        assert decode_any_tc(Reader(w.bytes())) == tc
+    finally:
+        restore()
+
+
+def test_legacy_certs_still_decode_through_versioned_codec():
+    """Entry-list QCs written through the versioned codec round-trip
+    unchanged — a pre-aggregate peer's certificates stay readable."""
+    digest = Digest.of(b"legacy-block")
+    keys, _ = _fleet(4)
+    msg = _vote_digest(digest, 7).data
+    from hotstuff_tpu.crypto import Signature
+
+    votes = tuple(
+        (pk, Signature(pysigner.sign(seed, msg))) for pk, seed in keys[:3]
+    )
+    qc = QC(digest, 7, votes)
+    w = Writer()
+    encode_any_qc(w, qc)
+    decoded = decode_any_qc(Reader(w.bytes()))
+    assert isinstance(decoded, QC) and decoded == qc
+    # and the legacy form grows with the signer count (the contrast)
+    w2 = Writer()
+    encode_any_qc(w2, QC(digest, 7, votes[:2]))
+    assert len(w2.bytes()) < len(w.bytes())
+
+
+# --- verification through the scheme seam -----------------------------------
+
+
+def test_aggqc_verify_binding_and_quorum():
+    keys, cmt = _fleet(4)
+    scheme, restore = _install_stub(keys)
+    try:
+        qc = _agg_qc(keys, cmt, scheme, signer_idx=[0, 1, 2])
+        qc.verify(cmt)  # 3 of 4 equal-stake: quorum, genuine aggregate
+        # sub-quorum bitmap fails structurally
+        with pytest.raises(QCRequiresQuorumError):
+            _agg_qc(keys, cmt, scheme, signer_idx=[0, 1]).verify(cmt)
+        # bitmap bit beyond the committee: malformed / wrong epoch
+        with pytest.raises(UnknownAuthorityError):
+            AggQC(qc.hash, qc.round, 1 << 4 | 0b111, qc.agg_sig).verify(cmt)
+        # bitmap<->committee binding: same signature, different claimed
+        # member set (swap signer 2 for non-signer 3)
+        with pytest.raises(InvalidSignatureError):
+            AggQC(qc.hash, qc.round, 0b1011, qc.agg_sig).verify(cmt)
+    finally:
+        restore()
+
+
+def test_epoch_boundary_certs_resolve_their_own_committee():
+    """With dynamic reconfiguration a certificate is judged against the
+    committee of its OWN round's epoch: an AggQC signed by epoch-2
+    members verifies when its round falls in epoch 2 and rejects when
+    the same bitmap is (mis)read against epoch 1's member list."""
+    keys_a, cmt_a = _fleet(4, tag=b"epoch1")
+    keys_b, cmt_b = _fleet(4, tag=b"epoch2")
+    scheme = TrustedAggScheme()
+    prev_scheme = aggsig.install_agg_scheme(scheme)
+    registry = {
+        pk.data: scheme.keypair_from_seed(seed)[0]
+        for pk, seed in keys_a + keys_b
+    }
+    prev_reg = aggsig.install_agg_registry(registry)
+
+    class Resolver:
+        """EpochManager-shaped: epoch 2 activates at round 10."""
+
+        def committee_for_round(self, round_):
+            return cmt_b if round_ >= 10 else cmt_a
+
+    try:
+        qc = _agg_qc(keys_b, cmt_b, scheme, round_=12, signer_idx=[0, 1, 2])
+        qc.verify(Resolver())  # judged against epoch 2's committee
+        # the same certificate pinned to a pre-boundary round reads its
+        # bitmap against epoch 1's member list -> wrong aggregate keys
+        pre = AggQC(qc.hash, 9, qc.bitmap, qc.agg_sig)
+        with pytest.raises(InvalidSignatureError):
+            pre.verify(Resolver())
+    finally:
+        aggsig.install_agg_scheme(prev_scheme)
+        aggsig.install_agg_registry(prev_reg)
+
+
+def test_aggregator_packs_partials_across_epoch_boundary():
+    """AggCertAggregator judges each partial's quorum against the
+    committee of the partial's OWN round — epoch-2 partials form an
+    AggQC under epoch 2's member list even when the aggregator was
+    built before the switch."""
+    from hotstuff_tpu.consensus.reconfig import EpochManager
+
+    keys_a, cmt_a = _fleet(4, tag=b"epoch1")
+    keys_b, cmt_b = _fleet(4, tag=b"epoch2", epoch=2)
+    scheme = TrustedAggScheme()
+    prev_scheme = aggsig.install_agg_scheme(scheme)
+    mgr = EpochManager(cmt_a, register_backend=False)
+    assert mgr.schedule.apply(10, cmt_b)  # epoch 2 activates at round 10
+
+    try:
+        agg = AggCertAggregator(mgr, window=4)
+        digest = Digest.of(b"boundary-block")
+        msg = _vote_digest(digest, 12).data
+        out = None
+        for i in range(3):
+            bm = aggsig.bitmap_of([keys_b[i][0]], cmt_b.sorted_keys())
+            out = agg.add_vote_partial(
+                AggVoteBundle(12, digest, bm, scheme.sign(keys_b[i][1], msg))
+            )
+        assert isinstance(out, AggQC) and out.signers() == 3
+        out.check_quorum(mgr)  # quorum holds under epoch 2's committee
+    finally:
+        aggsig.install_agg_scheme(prev_scheme)
+
+
+# --- the Handel partial set --------------------------------------------------
+
+
+def test_agg_partial_set_scores_merges_and_windows():
+    merges: list[tuple[str, str]] = []
+
+    def merge(a, b):
+        merges.append((a, b))
+        return a + b
+
+    ps = AggPartialSet(merge, window=3)
+    ps.add(0b0011, "ab", 0)
+    ps.add(0b0001, "a", 0)  # subset of an existing entry: score 0
+    assert [bm for bm, _, _ in ps.entries] == [0b0011]
+    ps.add(0b1100, "cd", 1)  # disjoint: merged packing retained too
+    assert ps.best()[0] == 0b1111
+    assert ps.best()[2] == 2  # depth = max(1, 0) + 1
+    assert merges == [("cd", "ab")]
+    # windowing: entries bounded no matter what floods in
+    ps.add(0b0110, "bc", 0)
+    assert len(ps.entries) <= 3
+
+
+# --- exact BLS12-381: one round-trip, one forged partial --------------------
+
+
+def test_exact_bls_aggregate_roundtrip_and_forged_partial():
+    """Two verifies total (each is a multi-pairing, ~10 s pure-python):
+    a genuine 2-of-2 aggregate accepts; swapping one partial for an
+    outsider's signature rejects. Everything cheaper about the exact
+    curve (compression, subgroup membership) rides along."""
+    scheme = aggsig.exact_scheme()
+    msg = b"exact-curve round trip"
+    pk1, sk1 = scheme.keypair_from_seed(b"\x01" * 32)
+    pk2, sk2 = scheme.keypair_from_seed(b"\x02" * 32)
+    _, sk3 = scheme.keypair_from_seed(b"\x03" * 32)
+    assert len(pk1) == aggsig.PK_BYTES and pk1 != pk2
+    s1, s2 = scheme.sign(sk1, msg), scheme.sign(sk2, msg)
+    assert len(s1) == aggsig.SIG_BYTES
+    agg = scheme.aggregate([s1, s2])
+    assert scheme.combine(s1, s2) == agg  # combine == pairwise aggregate
+    assert scheme.verify([pk1, pk2], msg, agg)
+    forged = scheme.aggregate([s1, scheme.sign(sk3, msg)])
+    assert not scheme.verify([pk1, pk2], msg, forged)

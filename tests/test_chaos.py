@@ -147,6 +147,28 @@ def test_same_seed_replays_bit_identically():
     assert (a["fault_trace"], a["commits"]) != (c["fault_trace"], c["commits"])
 
 
+def test_agg_certs_replays_bit_identically():
+    """The aggregate-certificate plane's bit-identity pin (§5.5o): the
+    trusted-agg stub's XOR combine is order-independent like point
+    addition, so same-seed fleets produce byte-identical aggregates no
+    matter which overlay path merged the partials — commits, fault
+    trace, AND the aggregate-plane counters must replay exactly."""
+    a = run_scenario("agg_certs", seed=21)
+    b = run_scenario("agg_certs", seed=21)
+    assert a["ok"], a
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["commits"] == b["commits"]
+    assert a["events"] == b["events"]
+    for key in (
+        "agg.qcs_formed",
+        "agg.partials_merged",
+        "agg.cert_bytes_committed",
+        "chaos.stub_agg_verifies",
+    ):
+        assert a["metrics"].get(key) == b["metrics"].get(key), key
+    assert a["metrics"]["agg.qcs_formed"] >= 4
+
+
 @pytest.mark.slow
 def test_crash_replay_is_deterministic():
     """Tier-1 diet (ISSUE 12): demoted to slow — the crash/restart

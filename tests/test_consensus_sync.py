@@ -8,11 +8,11 @@ from hotstuff_tpu.consensus.messages import (
     LoopBack,
     SyncRequest,
     decode_consensus_message,
+    encode_stored_block,
 )
 from hotstuff_tpu.consensus.synchronizer import Synchronizer
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.actors import channel
-from hotstuff_tpu.utils.serde import Writer
 import pytest
 
 # Whole-module OpenSSL dependency (tests/common.py is importable
@@ -27,9 +27,7 @@ def test_get_existing_parent(run_async, base_port):
         cmt = committee(base_port)
         b1, b2 = chain(2, cmt)
         store = Store()
-        w = Writer()
-        b1.encode(w)
-        await store.write(b1.digest().data, w.bytes())
+        await store.write(b1.digest().data, encode_stored_block(b1))
         sync = Synchronizer(keys()[0][0], cmt, store, channel(), channel(), 10_000)
         parent = await sync.get_parent_block(b2)
         assert parent == b1
@@ -58,9 +56,7 @@ def test_missing_parent_requests_then_loops_back(run_async, base_port):
         assert set(msg.addresses) == set(cmt.broadcast_addresses(me))
 
         # The parent arrives (e.g. via a peer's re-send) -> LoopBack fires.
-        w = Writer()
-        b1.encode(w)
-        await store.write(b1.digest().data, w.bytes())
+        await store.write(b1.digest().data, encode_stored_block(b1))
         lb = await asyncio.wait_for(core_channel.get(), 5)
         assert isinstance(lb, LoopBack) and lb.block == b2
 

@@ -313,7 +313,15 @@ def test_fleet_rollup_from_synthetic_report():
         "rate_per_s": 0.5,
         "min_node": 2,
         "max_node": 3,
+        # report carries no agg.cert_bytes_committed delta: the column
+        # reads "not measured", never a misleading 0.0 (§5.5o)
+        "bytes_per_committed_round": None,
     }
+    # with the counter present, the column is bytes / total commits
+    report["metrics"]["agg.cert_bytes_committed"] = 660
+    assert (
+        fleet_rollup(report)["commits"]["bytes_per_committed_round"] == 132.0
+    )
     assert rollup["lanes"]["consensus"]["worst_node"] == "1"
     assert rollup["occupancy"] == {"worst_node": "1", "worst": 0.7}
     assert rollup["alerts"] == {
@@ -342,6 +350,7 @@ def test_fleet_rollup_from_synthetic_report():
         "rate_per_s": 0.5,
         "min_node": 0,
         "max_node": 3,
+        "bytes_per_committed_round": 132.0,
     }
 
 

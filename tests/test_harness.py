@@ -395,6 +395,37 @@ def test_log_parser_no_metrics_lines_yields_empty_aggregate():
     assert "+ METRICS" not in p.result()
 
 
+def test_log_parser_scrapes_cert_plane_lines():
+    """The consensus core's cumulative 'Cert plane:' line surfaces as a
+    CERTS section: counts summed across nodes (LAST line per node — the
+    counter is cumulative), worst cert bytes and aggregation depth maxed;
+    absent when no node ever logged it."""
+    from benchmark.logs import LogParser
+
+    assert "+ CERTS" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node_a = NODE_LOG + (
+        "[2026-07-30T10:00:01.100Z INFO hotstuff.consensus] Cert plane: "
+        "3 aggregate / 2 entry-list certs committed, worst cert 428 B, "
+        "agg depth 2\n"
+        "[2026-07-30T10:00:02.100Z INFO hotstuff.consensus] Cert plane: "
+        "9 aggregate / 2 entry-list certs committed, worst cert 428 B, "
+        "agg depth 3\n"
+    )
+    node_b = NODE_LOG + (
+        "[2026-07-30T10:00:02.200Z INFO hotstuff.consensus] Cert plane: "
+        "7 aggregate / 1 entry-list certs committed, worst cert 204 B, "
+        "agg depth 5\n"
+    )
+    p = LogParser([CLIENT_LOG], [node_a, node_b])
+    assert (p.cert_agg, p.cert_legacy) == (16, 3)  # 9+7, 2+1: lasts, not sums
+    assert p.cert_worst_bytes == 428 and p.cert_depth == 5
+    assert p.cert_nodes == 2
+    out = p.result()
+    assert "+ CERTS:" in out
+    assert "19 (16 aggregate = 84.2 %, 3 entry-list) across 2 node(s)" in out
+    assert "Worst cert: 428 B, aggregation depth 5" in out
+
+
 # ---------------------------------------------------------------------------
 # tools/chaos_run.py: the chaos scenario CLI (hotstuff_tpu/chaos)
 

@@ -1,0 +1,77 @@
+"""ops/bls.py kernel units: the radix-2^12 CIOS Montgomery field ops
+against exact bigints, and the masked tree aggregation against the
+pure-python curve fold. No pairings here (tests/test_aggsig.py pins the
+exact verify leg); everything below is field/group arithmetic only."""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from hotstuff_tpu.crypto import aggsig
+from hotstuff_tpu.ops import bls
+
+
+def _limbs(x: int):
+    return jax.numpy.asarray(bls.limbs_of_int(x), jax.numpy.uint32)
+
+
+def _as_int(limbs) -> int:
+    return bls.int_of_limbs(np.asarray(limbs))[0]
+
+
+def test_field_ops_match_bigints():
+    """mont_mul/add_mod/sub_mod agree with exact integers on random
+    residues and stay inside the [0, 2p) Montgomery invariant."""
+    rng = random.Random(0xB15)
+    P = bls.P
+    for _ in range(12):
+        a, b = rng.randrange(P), rng.randrange(P)
+        am, bm = bls.to_mont(a), bls.to_mont(b)
+        prod = _as_int(bls.mont_mul(_limbs(am), _limbs(bm)))
+        assert prod < 2 * P
+        assert bls.from_mont(prod % P) == a * b % P
+        s = _as_int(bls.add_mod(_limbs(am), _limbs(bm)))
+        assert s < 2 * P and s % P == (am + bm) % P
+        d = _as_int(bls.sub_mod(_limbs(am), _limbs(bm)))
+        assert d < 2 * P and d % P == (am - bm) % P
+    # mont(1) round-trips and squaring matches
+    one = bls.to_mont(1)
+    assert bls.from_mont(_as_int(bls.mont_sqr(_limbs(one))) % P) == 1
+
+
+def test_committee_table_aggregates_match_exact_fold():
+    """Device tree-aggregates over a real-key table equal the exact
+    backend's affine fold for assorted bitmaps, including lanes that
+    force the doubling path (duplicate keys) and the empty sum."""
+    scheme = aggsig.exact_scheme()
+    keys = [
+        scheme.keypair_from_seed(bytes([i]) * 32)[0] for i in range(1, 6)
+    ]
+    keys.append(keys[0])  # duplicate lane: tree add hits P + P
+    table = bls.CommitteeTable(keys)
+    assert not table.invalid.any()
+    bitmaps = [0b000001, 0b011111, 0b100001, 0b111111, 0]
+    got = table.aggregate_bitmaps(bitmaps)
+    ops = aggsig._FP_OPS
+    for bm, pt in zip(bitmaps, got):
+        acc = None
+        for i in range(6):
+            if bm >> i & 1:
+                acc = ops.add_affine(acc, table.points[i])
+        assert pt == acc
+
+
+def test_committee_table_flags_invalid_lanes():
+    scheme = aggsig.exact_scheme()
+    good = scheme.keypair_from_seed(b"\x07" * 32)[0]
+    table = bls.CommitteeTable([good, b"\x00" * 48])
+    assert list(table.invalid) == [False, True]
+    # an invalid lane's bit contributes identity to a sum...
+    assert table.aggregate_bitmaps([0b10])[0] is None
+    # ...and verify_aggregate refuses any bitmap selecting it outright
+    assert not table.verify_aggregate(0b10, b"m", b"\x00" * 96)

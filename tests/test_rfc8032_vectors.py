@@ -3,11 +3,22 @@
 The kernel must accept every official (msg, pk, sig) vector and reject
 perturbations. Complements the OpenSSL cross-checks: these vectors are
 fixed, independent of any library on this host.
+
+Tier-1 diet (ISSUE 17): demoted to slow — at ~135 s this module was the
+single heaviest tier-1 item (one w4-kernel compile shared by both tests,
+so demoting either alone saves nothing). Verify-kernel correctness stays
+tier-1-pinned by test_ops_ed25519 (field/curve ops vs bigints),
+test_committee_verify (vector verification through the committee paths),
+and test_chaos_adversarial (forged-signature rejection end to end); the
+official vectors still run in the full (slow-inclusive) suite.
 """
 
 import numpy as np
+import pytest
 
 from hotstuff_tpu.ops import ed25519 as ed
+
+pytestmark = pytest.mark.slow
 
 # (secret-ignored) public key, message, signature — RFC 8032 §7.1
 VECTORS = [

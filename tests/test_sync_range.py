@@ -18,6 +18,7 @@ from hotstuff_tpu.consensus.messages import (
     SyncRangeRequest,
     SyncRequest,
     decode_consensus_message,
+    encode_stored_block,
 )
 from hotstuff_tpu.consensus.synchronizer import (
     RANGE_SYNC_THRESHOLD,
@@ -61,9 +62,9 @@ def _chain(length: int, author: PublicKey) -> list[Block]:
 
 
 async def _store_block(store: Store, block: Block) -> None:
-    w = Writer()
-    block.encode(w)
-    await store.write(block.digest().data, w.bytes())
+    # Store blobs carry the one-byte version prefix (encode_stored_block);
+    # raw Block.encode bytes are not a valid store blob.
+    await store.write(block.digest().data, encode_stored_block(block))
 
 
 def _mk_sync(cmt: Committee, store: Store, retry_ms: int = 1_000):

@@ -170,11 +170,20 @@ def run_matrix(args) -> int:
         if args.matrix_sizes
         else list(MATRIX_SIZES)
     )
+    def _sizes_for(name: str) -> list[int]:
+        # A scenario may pin its own size grid (Scenario.matrix_sizes —
+        # e.g. agg_certs sweeps {4, 64, 128} to exhibit the flat
+        # bytes-per-committed-round curve); an explicit --matrix-sizes
+        # still wins, so `--matrix-sizes 128,256` soaks do what they say.
+        if args.matrix_sizes:
+            return sizes
+        return list(SCENARIOS[name].matrix_sizes or sizes)
+
     specs = [
         {"scenario": s, "seed": seed, "n": n, "trusted": args.trusted}
         for s in names
         for seed in seeds
-        for n in sizes
+        for n in _sizes_for(s)
     ]
     out_path = args.report or _next_matrix_path(os.getcwd())
     # Resolve and load the baseline BEFORE the sweep: a typoed --baseline
@@ -218,10 +227,12 @@ def run_matrix(args) -> int:
 
     for c in cells:
         rollup = c["rollup"]
+        bpr = rollup["commits"].get("bytes_per_committed_round")
         print(
             f"MATRIX cell {c['cell']} {'green' if c['green'] else 'red'} "
             f"crypto={c['crypto_mode']} commits={rollup['commits']['total']} "
             f"rate={rollup['commits']['rate_per_s']}/s "
+            f"cert_B/round={bpr if bpr is not None else '-'} "
             f"wall={c['wall_seconds']}s"
         )
     print(f"MATRIX result: {green} green / {red} red of {len(cells)} cells")
