@@ -210,6 +210,17 @@ def _parse_node(text: str) -> dict:
     out["cert_plane"] = (
         tuple(int(x) for x in certs[-1]) if certs else None
     )
+    # Election-plane line (consensus/core.py _note_election_stats): the
+    # per-node cumulative propose->certify pivot attribution — rounds
+    # scored, co-located pivots, cross-region hops, and the in-run
+    # round-robin counterfactual. Cumulative per node, so the LAST line
+    # wins; absent (None, never zeros) when the run had no region map.
+    elect = _search_all(
+        r"Election plane: (\d+) round\(s\) committed, (\d+) co-located "
+        r"pivot\(s\), (\d+) cross-region hop\(s\), (\d+) blind",
+        text,
+    )
+    out["election"] = tuple(int(x) for x in elect[-1]) if elect else None
     # Network-observatory lines (consensus/core.py _log_peer_map): the
     # periodic per-vantage RTT map and cumulative probe counters. Both
     # are cumulative/monotone per node, so the LAST line wins — except
@@ -369,6 +380,14 @@ class LogParser:
         self.cert_worst_bytes = 0
         self.cert_depth = 0
         self.cert_nodes = 0
+        # Election-plane fold (cumulative per-node lines, like the cert
+        # plane): counts sum across nodes, with the contributing node
+        # count kept so per-commit rates stay honest.
+        self.elect_rounds = 0
+        self.elect_matches = 0
+        self.elect_hops = 0
+        self.elect_hops_blind = 0
+        self.elect_nodes = 0
         # Network-observatory scrapes: (peers, classes, worst EWMA ms) per
         # node that logged an RTT map, plus fleet probe send/answer totals.
         self.peer_rtts: list[tuple[int, int, float]] = []
@@ -422,6 +441,13 @@ class LogParser:
                 self.cert_worst_bytes = max(self.cert_worst_bytes, worst_b)
                 self.cert_depth = max(self.cert_depth, depth)
                 self.cert_nodes += 1
+            if r.get("election") is not None:
+                e_rounds, e_matches, e_hops, e_blind = r["election"]
+                self.elect_rounds += e_rounds
+                self.elect_matches += e_matches
+                self.elect_hops += e_hops
+                self.elect_hops_blind += e_blind
+                self.elect_nodes += 1
             if r.get("peer_rtt") is not None:
                 self.peer_rtts.append(r["peer_rtt"])
             if r.get("probes") is not None:
@@ -713,6 +739,20 @@ class LogParser:
                 f" Worst cert: {self.cert_worst_bytes:,} B,"
                 f" aggregation depth {self.cert_depth}\n"
             )
+        election = ""
+        if self.elect_nodes and self.elect_rounds:
+            match_pct = 100.0 * self.elect_matches / self.elect_rounds
+            hops_per = self.elect_hops / self.elect_rounds
+            blind_per = self.elect_hops_blind / self.elect_rounds
+            election = (
+                " + ELECTION:\n"
+                f" Pivots scored: {self.elect_rounds:,} committed round(s)"
+                f" across {self.elect_nodes} node(s)\n"
+                f" Co-located: {self.elect_matches:,} ({match_pct:.1f} %);"
+                f" cross-region hops: {self.elect_hops:,}"
+                f" ({hops_per:.3f}/commit vs {blind_per:.3f} under"
+                " round-robin)\n"
+            )
         reconfig = ""
         if self.epoch_switches or self.handoffs or self.range_lags:
             reconfig = " + RECONFIG:\n"
@@ -800,6 +840,7 @@ class LogParser:
             + matrix
             + agg
             + certs
+            + election
             + reconfig
             + mtr
             + "-----------------------------------------\n"

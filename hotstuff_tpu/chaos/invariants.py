@@ -22,6 +22,16 @@ Safety (2-chain HotStuff, consensus/src/messages.rs quorum rules):
                   epoch — on BOTH sides of a reconfiguration boundary. A
                   certificate quorate under the wrong epoch's committee
                   is a violation even if every signature is genuine.
+  * election    — the proposer of every committed block must be the
+                  leader the checker derives INDEPENDENTLY for that
+                  round from chain content alone: its own self-derived
+                  committee schedule plus the run's frozen region map,
+                  through the same pure rule the fleet's elector uses
+                  (round-robin, or consensus/leader.elect_region_aware
+                  when the run is region-aware, §5.5p). This pins that
+                  region-aware schedules resolve bit-identically on
+                  every node — a schedule split would surface as an
+                  unelected proposer's block getting committed.
   * handoff     — the epoch-final contract, derived from chain content
                   alone: for every committed EpochChange, the carrier's
                   2-chain completion (a pair of consecutive-round
@@ -39,6 +49,7 @@ healed, crashed nodes restarted) — evaluated per honest node.
 
 from __future__ import annotations
 
+from ..consensus.leader import elect_region_aware
 from ..consensus.reconfig import EpochSchedule
 from ..crypto import pysigner
 from ..utils import metrics
@@ -48,11 +59,22 @@ _M_VIOLATIONS = metrics.counter("chaos.invariant_violations")
 
 
 class SafetyChecker:
-    def __init__(self, committee) -> None:
+    def __init__(
+        self,
+        committee,
+        region_of: dict | None = None,
+        region_aware: bool = False,
+    ) -> None:
         self.committee = committee
         # Independent epoch view derived from the committed chain itself —
         # never from any node's EpochManager state.
         self.schedule = EpochSchedule(committee)
+        # Election audit inputs: the run's frozen region map (the same
+        # seed-derived map the fleet elects by) and whether the fleet
+        # runs the region-aware schedule. The DERIVATION stays the
+        # checker's own: its self-built schedule, never a node's elector.
+        self.region_of = dict(region_of or {})
+        self.region_aware = bool(region_aware)
         self.violations: list[str] = []
         self._by_round: dict[int, tuple[bytes, int]] = {}  # round -> (digest, node)
         self._last: dict[int, object] = {}  # node -> last committed block
@@ -100,10 +122,43 @@ class SafetyChecker:
                     f"different round-{prev.round} block than it committed"
                 )
         self._last[node] = block
+        self._check_leader(node, block)
         self._check_certificate(node, block)
         if getattr(block, "reconfig", None) is not None:
             self._check_reconfig(node, block)
         self._check_handoffs(block)
+
+    def expected_leader(self, round_: int):
+        """The round's leader derived from chain content alone: the
+        checker's self-built schedule plus the frozen region map —
+        the same pure function every honest elector computes
+        (consensus/leader.py §5.5p)."""
+        keys = self.schedule.sorted_keys_for_round(round_)
+        if self.region_aware:
+            return elect_region_aware(round_, keys, self.region_of)
+        return keys[round_ % len(keys)]
+
+    def _check_leader(self, node: int, block) -> None:
+        """Election-schedule audit: a committed block authored by anyone
+        but the independently derived leader of its round means either
+        a forged proposal survived or honest nodes disagree on the
+        schedule (the region-aware split hazard)."""
+        author = getattr(block, "author", None)
+        if author is None:
+            return
+        _M_CHECKS.inc()
+        try:
+            expected = self.expected_leader(block.round)
+        except Exception:
+            # A round outside the checker's derived schedule (stale
+            # replay artifacts) is judged by the other invariants.
+            return
+        if author != expected:
+            self._violate(
+                f"election schedule violated: node {node} committed "
+                f"B{block.round} authored by {author.short()}, expected "
+                f"leader {expected.short()}"
+            )
 
     def _check_certificate(self, node: int, block) -> None:
         """Re-verify the committed block's embedded QC with the independent

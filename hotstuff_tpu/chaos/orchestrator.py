@@ -288,10 +288,9 @@ class ChaosOrchestrator:
         self.transport = FaultyTransport(
             self.plan, self.rng, {BASE_PORT + i: i for i in range(n)}
         )
-        self.safety = SafetyChecker(self.committee)
-        self.liveness = LivenessChecker()
         # WAN region labels for the aggregation overlay's region-aware
-        # tree (consensus/overlay.py): the SAME seed-derived map the
+        # tree (consensus/overlay.py) AND the region-aware elector
+        # (consensus/leader.py §5.5p): the SAME seed-derived map the
         # transport charges latency by, so the tree's intra-region edges
         # really are the cheap ones. Built once — it is invariant for
         # the run (every boot/restart shares it).
@@ -303,6 +302,14 @@ class ChaosOrchestrator:
             if self.transport.regions
             else None
         )
+        # The checker gets the frozen region map + elector mode so its
+        # election audit derives the schedule INDEPENDENTLY per round.
+        self.safety = SafetyChecker(
+            self.committee,
+            region_of=self.overlay_regions,
+            region_aware=self.parameters.region_aware_election,
+        )
+        self.liveness = LivenessChecker()
         self.honest = [i for i in range(n) if i not in self.byzantine]
         self.ingress = ingress
         self.ingress_drivers: list[tuple[int, object]] = []  # (node, loadgen)

@@ -80,6 +80,14 @@ class WanMatrix:
     regions: tuple[str, ...] = _DEFAULT_REGIONS
     rtt_ms: tuple[tuple[str, str, float], ...] = _DEFAULT_RTT_MS
     intra_rtt_ms: float = 4.0
+    # Optional occupancy weights, one per region in `regions` order.
+    # None (the default, and every pre-§5.5p committed cell) keeps the
+    # balanced round-robin assignment below BIT-IDENTICAL. A weighted
+    # matrix models a skewed fleet — the geometry where a plurality
+    # region actually exists and plurality-first election has something
+    # to win (wan_election cells run 40/30/20/10): seats go by largest
+    # remainder, so at small n the lightest regions may sit empty.
+    weights: tuple[float, ...] | None = None
 
     def __post_init__(self) -> None:
         table = {}
@@ -95,6 +103,13 @@ class WanMatrix:
         ]
         if missing:
             raise ValueError(f"WanMatrix missing RTT for region pairs {missing}")
+        if self.weights is not None and (
+            len(self.weights) != len(self.regions)
+            or any(w <= 0 for w in self.weights)
+        ):
+            raise ValueError(
+                "WanMatrix weights must be positive, one per region"
+            )
         object.__setattr__(self, "_one_way", table)
 
     def one_way_s(self, src_region: str, dst_region: str) -> float:
@@ -102,20 +117,44 @@ class WanMatrix:
 
     def assign(self, rng, n: int) -> list[str]:
         """Region per node index, a pure function of the given seeded
-        stream: the region LIST is shuffled once, then nodes take regions
-        round-robin — balanced occupancy (every region within 1 of n/R)
-        with a seed-dependent mapping, so two seeds exercise different
-        leader-region geometries without ever emptying a region."""
-        order = list(self.regions)
-        rng.shuffle(order)
-        return [order[i % len(order)] for i in range(n)]
+        stream. Balanced mode (weights=None): the region LIST is
+        shuffled once, then nodes take regions round-robin — balanced
+        occupancy (every region within 1 of n/R) with a seed-dependent
+        mapping, so two seeds exercise different leader-region
+        geometries without ever emptying a region. Weighted mode: seats
+        per region by largest remainder over the weights, then the seat
+        list is shuffled once — same determinism contract, skewed
+        occupancy."""
+        if self.weights is None:
+            order = list(self.regions)
+            rng.shuffle(order)
+            return [order[i % len(order)] for i in range(n)]
+        total = sum(self.weights)
+        quotas = [n * w / total for w in self.weights]
+        seats = [int(q) for q in quotas]
+        remainders = sorted(
+            range(len(self.regions)),
+            key=lambda i: (-(quotas[i] - seats[i]), i),
+        )
+        for i in remainders[: n - sum(seats)]:
+            seats[i] += 1
+        assignment = [
+            region
+            for region, count in zip(self.regions, seats)
+            for _ in range(count)
+        ]
+        rng.shuffle(assignment)
+        return assignment
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "regions": list(self.regions),
             "rtt_ms": [list(row) for row in self.rtt_ms],
             "intra_rtt_ms": self.intra_rtt_ms,
         }
+        if self.weights is not None:
+            out["weights"] = list(self.weights)
+        return out
 
 
 @dataclass(frozen=True)

@@ -125,6 +125,27 @@ class Parameters:
     # committed same-seed determinism pin. Scenarios that measure the
     # network (wan_observatory) opt in explicitly.
     probe_interval_ms: int = 0
+    # Region-aware leader election (§5.5p, consensus/leader.py):
+    # region-block rotation — the plurality WAN region's members lead
+    # consecutively first, then the next region's, so the commit-critical
+    # propose->certify pivot crosses regions only at region seams.
+    # Default OFF: round-robin is the committed-determinism baseline;
+    # the wan_election chaos cells and WAN deployments opt in. The
+    # schedule stays a pure function of (round, committee, region map),
+    # so flipping this on changes WHICH deterministic schedule runs,
+    # never introduces nondeterminism.
+    region_aware_election: bool = False
+    # Leader-rooted vote collection (§5.5p): votes for round r flow to
+    # round r's OWN leader (collector == leader's region head by
+    # construction — under region-aware election the whole quorum path
+    # stays inside the proposing region), and the finished certificate
+    # rides ONE explicit handoff frame to round r+1's proposer. Default
+    # OFF: the committed baseline roots the vote plane at the NEXT
+    # leader, whose moving target pipelines the vote trip into the next
+    # proposal broadcast — the wiring region placement cannot shorten.
+    # The wan_election cells enable this in BOTH A/B arms so the only
+    # varied bit is the election schedule itself.
+    leader_collector: bool = False
 
     def log(self, log) -> None:
         # NOTE: these log entries are parsed by the benchmark LogParser.
@@ -135,6 +156,10 @@ class Parameters:
         log.info("Timeout backoff set to %s", self.timeout_backoff)
         if self.probe_interval_ms:
             log.info("Probe interval set to %s ms", self.probe_interval_ms)
+        if self.region_aware_election:
+            log.info("Region-aware election enabled")
+        if self.leader_collector:
+            log.info("Leader-rooted vote collection enabled")
 
     def to_json(self) -> dict:
         return {
@@ -152,6 +177,8 @@ class Parameters:
             "aggregate_certs": self.aggregate_certs,
             "agg_window": self.agg_window,
             "probe_interval_ms": self.probe_interval_ms,
+            "region_aware_election": self.region_aware_election,
+            "leader_collector": self.leader_collector,
         }
 
     @staticmethod

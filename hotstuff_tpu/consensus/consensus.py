@@ -15,7 +15,7 @@ from ..store import Store
 from ..utils.actors import channel, spawn
 from .config import Committee, Parameters
 from .core import Core
-from .leader import LeaderElector
+from .leader import LeaderElector, RegionAwareElector
 from .mempool_driver import MempoolDriver
 from .messages import decode_consensus_message
 from .reconfig import EpochManager, as_manager
@@ -81,7 +81,15 @@ class Consensus:
         )
         NetSender(network_tx, name="consensus-sender")
 
-        leader_elector = LeaderElector(epochs)
+        # Elector seam (§5.5p): region-aware placement consumes the SAME
+        # region map the aggregation overlay trees by, so the vote-plane
+        # collector (overlay roots the tree at get_leader(round+1)) and
+        # the leader co-locate by construction.
+        leader_elector = (
+            RegionAwareElector(epochs, region_of=overlay_regions)
+            if parameters.region_aware_election
+            else LeaderElector(epochs)
+        )
         mempool_driver = MempoolDriver(mempool_channel)
         synchronizer = Synchronizer(
             name,

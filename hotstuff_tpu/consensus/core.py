@@ -117,6 +117,24 @@ _M_PARKED = metrics.counter("sync.parked_blocks")
 # bytes_per_committed_round columns (utils/telemetry.fleet_rollup).
 _M_AGG_PARTIAL_REJECTS = metrics.counter("agg.partial_rejects")
 _M_AGG_CERT_BYTES = metrics.counter("agg.cert_bytes_committed")
+# Region-aware election attribution (§5.5p). Counted per COMMITTED round
+# whenever a region map is wired (EVERY elector mode, so region-blind
+# and region-aware cells expose comparable hop columns). The accounted
+# leg is the commit-critical propose->certify PIVOT: round r's finished
+# certificate reaching round r+1's proposer. Under leader-collector
+# rooting that is a literal frame (the _handoff_qc bundle, leader r ->
+# leader r+1); under next-leader rooting it is the last tree edge into
+# the collector. Either way broadcast/tree frame TOTALS are placement-
+# invariant under a population-proportional map; the pivot is the leg
+# election placement actually controls. cross_region_hops counts pivots
+# that crossed regions, leader_region_matches the co-located ones (they
+# partition elect.rounds), and cross_region_hops_blind prices the SAME
+# rounds under round-robin placement — a deterministic in-artifact
+# counterfactual A/B.
+_M_ELECT_ROUNDS = metrics.counter("elect.rounds")
+_M_ELECT_MATCHES = metrics.counter("elect.leader_region_matches")
+_M_ELECT_HOPS = metrics.counter("elect.cross_region_hops")
+_M_ELECT_HOPS_BLIND = metrics.counter("elect.cross_region_hops_blind")
 
 # Cap on the first-seen timestamp map feeding commit_latency_s: Byzantine
 # proposals that never commit must not grow it without bound.
@@ -185,6 +203,13 @@ class Core:
         self._legacy_certs_committed = 0
         self._worst_cert_bytes = 0
         self._agg_depth_max = 0
+        # Cumulative election-plane commit stats feeding the
+        # "Election plane:" log line (benchmark LogParser's + ELECTION
+        # section). Zero — and the line absent — without a region map.
+        self._elect_rounds = 0
+        self._elect_matches = 0
+        self._elect_hops = 0
+        self._elect_hops_blind = 0
         # The aggregator seeds verified vote/timeout signatures into the
         # service's dedup cache, so assembled QCs/TCs short-circuit.
         self.aggregator = Aggregator(self.epochs, self.verification_service)
@@ -455,6 +480,7 @@ class Core:
             d = b.digest()
             _M_COMMITS.inc()
             self._note_cert_stats(b)
+            self._note_election_stats(b)
             seen = self._block_seen.pop(d, None)
             if seen is not None:
                 _M_COMMIT_LATENCY.record(now - seen)
@@ -479,6 +505,16 @@ class Core:
             self._worst_cert_bytes,
             self._agg_depth_max,
         )
+        if self._elect_rounds:
+            # NOTE: parsed by the benchmark LogParser (+ ELECTION section).
+            log.info(
+                "Election plane: %d round(s) committed, %d co-located "
+                "pivot(s), %d cross-region hop(s), %d blind",
+                self._elect_rounds,
+                self._elect_matches,
+                self._elect_hops,
+                self._elect_hops_blind,
+            )
 
     def _note_cert_stats(self, block: Block) -> None:
         """Per-committed-block certificate accounting: the encoded bytes
@@ -500,6 +536,36 @@ class Core:
                 self._agg_certs_committed += 1
             else:
                 self._legacy_certs_committed += 1
+
+    def _note_election_stats(self, block: Block) -> None:
+        """Per-committed-round election geometry (§5.5p): does the
+        round's propose->certify pivot — its certificate travelling
+        from round r's leader to round r+1's proposer (the _handoff_qc
+        frame under leader-collector rooting) — stay inside one region?
+        Scores the same pivot under round-robin placement as the blind
+        counterfactual. Pure arithmetic over the frozen region map and
+        the committed round — counters only, so same-seed replay stays
+        bit-identical."""
+        regions = self.overlay.region_of
+        if not regions:
+            return
+        leader = self.leader_elector.get_leader(block.round)
+        collector = self.leader_elector.get_leader(block.round + 1)
+        self._elect_rounds += 1
+        _M_ELECT_ROUNDS.inc()
+        if regions.get(leader, "") == regions.get(collector, ""):
+            self._elect_matches += 1
+            _M_ELECT_MATCHES.inc()
+        else:
+            self._elect_hops += 1
+            _M_ELECT_HOPS.inc()
+        keys = self.epochs.schedule.sorted_keys_for_round(block.round)
+        next_keys = self.epochs.schedule.sorted_keys_for_round(block.round + 1)
+        blind_leader = keys[block.round % len(keys)]
+        blind_collector = next_keys[(block.round + 1) % len(next_keys)]
+        if regions.get(blind_leader, "") != regions.get(blind_collector, ""):
+            self._elect_hops_blind += 1
+            _M_ELECT_HOPS_BLIND.inc()
 
     # -- round pacing --------------------------------------------------------
 
@@ -759,29 +825,35 @@ class Core:
                 "vote", tracing.trace_id(block.round, block.digest().data)
             )
         log.debug("created %s", vote)
-        next_leader = self.leader_elector.get_leader(self.round + 1)
+        # Vote sink: the next leader (baseline — it needs the QC to
+        # propose), or THIS round's leader under leader-collector mode
+        # (§5.5p — the certificate forms in the proposing region and
+        # hands off to the next proposer in one frame, _handoff_qc).
+        sink = self.leader_elector.get_leader(
+            self.round if self.parameters.leader_collector else self.round + 1
+        )
         if isinstance(vote, AggVoteBundle):
-            if next_leader == self.name:
+            if sink == self.name:
                 await self._handle_agg_vote_bundle(vote)
             elif self.overlay.enabled:
                 await self.overlay.on_own_vote_agg(vote)
             else:
                 await self._transmit(
-                    vote, next_leader,
+                    vote, sink,
                     trace=self._trace_ctx(vote.round, vote.hash),
                 )
                 note_plane_frames(KIND_VOTE, 1)
             return
-        if next_leader == self.name:
+        if sink == self.name:
             await self._handle_vote(vote)
         elif self.overlay.enabled:
             # Overlay mode: the vote rides the region-aware tree rooted
-            # at the next leader — interior nodes merge partial bundles
-            # so the leader's fan-in is O(fanout), not O(n).
+            # at the sink — interior nodes merge partial bundles so the
+            # collector's fan-in is O(fanout), not O(n).
             await self.overlay.on_own_vote(vote)
         else:
             await self._transmit(
-                vote, next_leader,
+                vote, sink,
                 trace=self._trace_ctx(vote.round, vote.hash),
             )
             note_plane_frames(KIND_VOTE, 1)
@@ -895,6 +967,35 @@ class Core:
             await self._process_qc(qc)
             if self.leader_elector.get_leader(self.round) == self.name:
                 await self._generate_proposal(None)
+            else:
+                await self._handoff_qc(qc)
+
+    async def _handoff_qc(self, qc: QC | AggQC) -> None:
+        """Leader-collector handoff (§5.5p): this node collected round
+        r's votes (it is round r's leader — Parameters.leader_collector
+        roots the vote plane there) but round r+1's proposer sits
+        elsewhere. The COMPLETE certificate rides one explicit bundle
+        frame to the next leader, which re-verifies and assembles its
+        own QC through the ordinary bundle handlers — no new message
+        type, and the frame is the literal propose->certify pivot the
+        elect.cross_region_hops counter prices. No-op outside
+        leader-collector mode (the baseline's next-leader sink already
+        holds the QC it needs)."""
+        if not self.parameters.leader_collector:
+            return
+        next_leader = self.leader_elector.get_leader(qc.round + 1)
+        if next_leader == self.name:
+            return
+        if hasattr(qc, "votes"):
+            bundle = VoteBundle(qc.round, qc.hash, tuple(qc.votes))
+        else:
+            bundle = AggVoteBundle(qc.round, qc.hash, qc.bitmap, qc.agg_sig)
+        note_plane_frames(KIND_VOTE, 1)
+        await self._transmit(
+            bundle, next_leader,
+            urgent=True,
+            trace=self._trace_ctx(qc.round, qc.hash),
+        )
 
     def _note_tc(self, tc: TC | AggTC) -> None:
         if self.last_tc is None or tc.round > self.last_tc.round:
@@ -1022,7 +1123,7 @@ class Core:
         new = self.overlay.merge(key, valid)
         if not new or bundle.round < self.round:
             return
-        if self.leader_elector.get_leader(bundle.round + 1) == self.name:
+        if self._vote_sink(bundle.round):
             for pk, sig in new:
                 qc = self.aggregator.add_vote_entry(
                     bundle.round, bundle.hash, pk, sig
@@ -1037,9 +1138,55 @@ class Core:
                     await self._process_qc(qc)
                     if self.leader_elector.get_leader(self.round) == self.name:
                         await self._generate_proposal(None)
+                    else:
+                        await self._handoff_qc(qc)
                     return
         else:
+            if await self._try_collector_quorum(key, bundle.round):
+                return
             await self.overlay.after_merge(key)
+
+    def _vote_sink(self, round_: Round) -> bool:
+        """Is this node the vote-plane COLLECTOR for `round_` — the one
+        assembler that feeds verified entries into its own QC
+        aggregator? Exactly the node the round's tree roots at: the
+        next leader (baseline — it needs the QC to propose) or the
+        round's own leader under leader-collector mode (§5.5p). Nobody
+        else may sink partials — under leader-collector the next leader
+        sits INTERIOR in the round's tree, and swallowing its children's
+        partials would starve the collector's subtree of quorum. The
+        next leader instead assembles via the merged-state quorum watch
+        (_try_collector_quorum) once the handoff frame lands."""
+        return self.leader_elector.get_leader(
+            round_ if self.parameters.leader_collector else round_ + 1
+        ) == self.name
+
+    async def _try_collector_quorum(self, key: tuple, round_: Round) -> bool:
+        """Leader-collector quorum watch (§5.5p): the next proposer,
+        merging vote partials as an ordinary interior node, assembles
+        the certificate directly from merged overlay state the moment
+        coverage reaches quorum — one merge after the collector's
+        complete handoff bundle lands (or after fallback gossip
+        delivers the same coverage the hard way). Returns True when a
+        certificate was assembled and processed."""
+        if not self.parameters.leader_collector or self.round > round_:
+            return False
+        if self.leader_elector.get_leader(round_ + 1) != self.name:
+            return False
+        committee = self.epochs.committee_for_round(round_)
+        qc = self.overlay.quorum_certificate(key, committee)
+        if qc is None:
+            return False
+        # NOTE: parsed by the benchmark LogParser (+ AGG:).
+        log.info(
+            "Agg bundle quorum: QC round %s from %s entries",
+            qc.round,
+            qc.signers() if hasattr(qc, "signers") else len(qc.votes),
+        )
+        await self._process_qc(qc)
+        if self.leader_elector.get_leader(self.round) == self.name:
+            await self._generate_proposal(None)
+        return True
 
     async def _handle_timeout_bundle(self, bundle: TimeoutBundle) -> None:
         """Aggregation-overlay partial timeout quorum: entries and the
@@ -1158,7 +1305,7 @@ class Core:
             raise
         if bundle.depth > self._agg_depth_max:
             self._agg_depth_max = bundle.depth
-        if self.leader_elector.get_leader(bundle.round + 1) == self.name:
+        if self._vote_sink(bundle.round):
             qc = self.agg_aggregator.add_vote_partial(bundle)
             if qc is not None:
                 # NOTE: parsed by the benchmark LogParser (+ AGG:).
@@ -1170,11 +1317,15 @@ class Core:
                 await self._process_qc(qc)
                 if self.leader_elector.get_leader(self.round) == self.name:
                     await self._generate_proposal(None)
+                else:
+                    await self._handoff_qc(qc)
             return
         key = OverlayRouter.vote_key(bundle.round, bundle.hash)
         self.overlay.merge_agg_vote(
             key, bundle.bitmap, bundle.agg_sig, bundle.depth
         )
+        if await self._try_collector_quorum(key, bundle.round):
+            return
         await self.overlay.after_merge(key)
 
     async def _handle_agg_timeout_bundle(self, bundle: AggTimeoutBundle) -> None:

@@ -66,8 +66,10 @@ from ..crypto import Digest, PublicKey, aggsig, sha512_32
 from ..utils import metrics, tracing
 from ..utils.actors import spawn
 from .aggregator import AggPartialSet, _merge_timeout_payload
+from .errors import ConsensusError
 from .messages import (
     QC,
+    AggQC,
     AggTimeoutBundle,
     AggVoteBundle,
     Round,
@@ -296,8 +298,18 @@ class OverlayRouter:
         if t is None:
             epochs = self.core.epochs
             members = epochs.schedule.sorted_keys_for_round(round_)
+            # Vote-plane root: the NEXT leader needs the QC to propose —
+            # the baseline roots the tree there. Leader-collector mode
+            # (§5.5p) roots it at the CURRENT leader instead (collector
+            # == leader's region head by construction); the certificate
+            # then rides one explicit handoff frame to the next proposer
+            # (core._handoff_qc).
             collector = (
-                self.core.leader_elector.get_leader(round_ + 1)
+                self.core.leader_elector.get_leader(
+                    round_
+                    if self.core.parameters.leader_collector
+                    else round_ + 1
+                )
                 if kind == KIND_VOTE
                 else None
             )
@@ -421,6 +433,37 @@ class OverlayRouter:
             best = st.agg_set.best()
             return best[0].bit_count() if best else 0
         return len(st.entries)
+
+    def quorum_certificate(self, key: tuple, committee) -> QC | AggQC | None:
+        """The complete certificate this vote key's merged state can
+        assemble, or None below quorum stake. The leader-collector
+        quorum watch (§5.5p): under Parameters.leader_collector the
+        NEXT leader is an ordinary interior node of the round's tree —
+        the collector is the round's own leader — so it cannot sink
+        partials into an aggregator without starving the collector's
+        subtree. Instead it assembles straight from merged state the
+        moment coverage reaches quorum, which the collector's explicit
+        handoff frame (core._handoff_qc, a whole-QC bundle) delivers in
+        one merge. Entries here are already verified (only verified
+        partials merge), so the check is structural stake arithmetic."""
+        st = self._state.get(key)
+        if st is None or key[0] != KIND_VOTE:
+            return None
+        if st.agg_set is not None:
+            best = st.agg_set.best()
+            if best is None:
+                return None
+            bitmap, sig, _depth = best
+            qc: QC | AggQC = AggQC(key[2], key[1], bitmap, sig)
+        elif st.entries:
+            qc = QC(key[2], key[1], tuple(st.entries.values()))
+        else:
+            return None
+        try:
+            qc.check_quorum(committee)
+        except ConsensusError:
+            return None
+        return qc
 
     # -- egress --------------------------------------------------------------
 

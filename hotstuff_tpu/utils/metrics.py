@@ -613,6 +613,14 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("agg.partials_merged", "counter", None),
     ("agg.partial_rejects", "counter", None),
     ("agg.cert_bytes_committed", "counter", None),
+    # consensus/leader.py + core.py — region-aware election (§5.5p).
+    # Counted per committed round whenever a region map is wired, in
+    # EVERY elector mode; cross_region_hops_blind is the round-robin
+    # counterfactual priced on the same rounds (in-artifact A/B).
+    ("elect.rounds", "counter", None),
+    ("elect.leader_region_matches", "counter", None),
+    ("elect.cross_region_hops", "counter", None),
+    ("elect.cross_region_hops_blind", "counter", None),
     ("consensus.round", "gauge", None),
     ("consensus.proposal_to_vote_s", "histogram", None),
     ("consensus.qc_form_s", "histogram", None),

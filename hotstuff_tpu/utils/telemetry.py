@@ -812,7 +812,9 @@ def peer_latency_map(peers: dict[str, dict]) -> dict[str, dict[str, float]]:
 # Counter prefixes a matrix cell keeps from the scenario's metric deltas:
 # the scale/health counters a regression diff is judged on, not the full
 # delta dump (which stays in the per-scenario report).
-_ROLLUP_COUNTER_PREFIXES = ("sync.", "reconfig.", "wan.", "chaos.", "agg.")
+_ROLLUP_COUNTER_PREFIXES = (
+    "sync.", "reconfig.", "wan.", "chaos.", "agg.", "elect.",
+)
 
 
 def fleet_rollup(report: dict) -> dict:
@@ -885,24 +887,66 @@ def fleet_rollup(report: dict) -> dict:
     latency = peer_latency_map(peers)
     peer_rtt = None
     if latency:
-        inferred = infer_fleet_regions(latency)
-        cross = [
-            rtt
-            for a, row in latency.items()
-            for b, rtt in row.items()
-            if inferred.get(a) != inferred.get(b)
-        ]
+        links = sum(len(row) for row in latency.values())
+        # Region inference needs the FULL fleet mesh: with a partial
+        # latency map (probe plane off on some nodes, or loops not yet
+        # closed) the union-find only sees the measured nodes and its
+        # region_count misleads — one sub-threshold link reads as "one
+        # region". Honest answer: report the raw links/worst columns
+        # always, the inference columns only at full coverage, and the
+        # coverage fraction so dashboards can say WHY they're absent.
+        n = int(report.get("nodes") or 0)
+        expected_links = n * (n - 1)
+        full_coverage = (
+            n > 1 and len(latency) == n and links >= expected_links
+        )
         peer_rtt = {
-            "links": sum(len(row) for row in latency.values()),
+            "links": links,
+            "coverage": (
+                round(min(1.0, links / expected_links), 3)
+                if expected_links
+                else None
+            ),
             "worst_ewma_ms": round(
                 max(rtt for row in latency.values() for rtt in row.values()),
                 3,
             ),
-            "worst_cross_region_ewma_ms": (
+            "worst_cross_region_ewma_ms": None,
+            "inferred_regions": None,
+            "region_count": None,
+        }
+        if full_coverage:
+            inferred = infer_fleet_regions(latency)
+            cross = [
+                rtt
+                for a, row in latency.items()
+                for b, rtt in row.items()
+                if inferred.get(a) != inferred.get(b)
+            ]
+            peer_rtt["worst_cross_region_ewma_ms"] = (
                 round(max(cross), 3) if cross else None
-            ),
-            "inferred_regions": inferred,
-            "region_count": len(set(inferred.values())),
+            )
+            peer_rtt["inferred_regions"] = inferred
+            peer_rtt["region_count"] = len(set(inferred.values()))
+    # Election attribution (§5.5p): the elect.* counters accrue once per
+    # node per committed round whenever a region map is wired, so the
+    # per-commit averages divide fleet totals by fleet round-commits.
+    # None when no elect.rounds moved (region-less run or old report) —
+    # absence, not a zero claim.
+    elect_rounds = int(metrics_delta.get("elect.rounds") or 0)
+    election = None
+    if elect_rounds:
+        matches = int(metrics_delta.get("elect.leader_region_matches") or 0)
+        hops = int(metrics_delta.get("elect.cross_region_hops") or 0)
+        blind = int(metrics_delta.get("elect.cross_region_hops_blind") or 0)
+        election = {
+            "rounds": elect_rounds,
+            "leader_region_matches": matches,
+            "match_rate": round(matches / elect_rounds, 4),
+            "cross_region_hops": hops,
+            "hops_per_commit": round(hops / elect_rounds, 3),
+            "cross_region_hops_blind": blind,
+            "blind_hops_per_commit": round(blind / elect_rounds, 3),
         }
     return {
         "nodes": report.get("nodes"),
@@ -961,6 +1005,7 @@ def fleet_rollup(report: dict) -> dict:
             if k.startswith(_ROLLUP_COUNTER_PREFIXES)
         },
         "peer_rtt": peer_rtt,
+        "election": election,
         "fault_trace_truncated": bool(report.get("fault_trace_truncated")),
     }
 

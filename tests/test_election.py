@@ -1,0 +1,396 @@
+"""Region-aware leader election (§5.5p, consensus/leader.py).
+
+The schedule is a PURE function of (round, committee-of-round, frozen
+region map): these tests pin the rotation geometry (plurality region
+first, members contiguous per region, every member once per cycle),
+the construction-time fallback order (measured RTTs -> seeded map ->
+round-robin), bit-identical restart/epoch-boundary determinism, the
+SafetyChecker's independent derivation, the weighted WanMatrix seat
+assignment the wan_election cells run on, and the downstream
+attribution surfaces (fleet_rollup election block, LogParser
+`+ ELECTION:` scrape, trace_report region annotation).
+
+The chaos-level tests run the "wan_election" grid scenario itself —
+whose expectation replays the region-blind twin "wan_election_blind"
+in-cell — so the A/B contract the matrix artifact pins is exercised
+tier-1 at n=4.
+"""
+
+import json
+
+import pytest
+
+from hotstuff_tpu.consensus.config import Committee
+from hotstuff_tpu.consensus.leader import (
+    LeaderElector,
+    RegionAwareElector,
+    elect_region_aware,
+    plurality_region,
+)
+from hotstuff_tpu.crypto import PublicKey, pysigner
+
+pytestmark = pytest.mark.chaos
+
+
+def _keys(n):
+    # pysigner keypairs (pure Python): these tests need key IDENTITIES,
+    # not signatures, so they run on hosts without the OpenSSL wheel.
+    return [
+        PublicKey(pysigner.keypair_from_seed(bytes([i + 1]) * 32)[0])
+        for i in range(n)
+    ]
+
+
+def _committee(pks, epoch=1):
+    return Committee.new(
+        [(pk, 1, ("127.0.0.1", 9_000 + i)) for i, pk in enumerate(pks)],
+        epoch=epoch,
+    )
+
+
+def _region_map(sorted_keys, labels):
+    return {pk: label for pk, label in zip(sorted_keys, labels)}
+
+
+# ---------------------------------------------------------------------------
+# The pure schedule rule
+
+
+def test_plurality_region_prefers_size_then_smaller_label():
+    ks = _keys(4)
+    assert (
+        plurality_region(ks, _region_map(ks, ["b", "b", "a", "a"])) == "a"
+    )  # tie on size -> smaller label
+    assert (
+        plurality_region(ks, _region_map(ks, ["b", "b", "b", "a"])) == "b"
+    )
+
+
+def test_region_schedule_degrades_to_round_robin():
+    """An empty or single-region map must be BIT-IDENTICAL to the legacy
+    elector — a region-less fleet sees no behavior change at all."""
+    cmt = _committee(_keys(4))
+    ks = cmt.sorted_keys()
+    legacy = [ks[r % len(ks)] for r in range(12)]
+    assert [elect_region_aware(r, ks, {}) for r in range(12)] == legacy
+    single = _region_map(ks, ["solo"] * 4)
+    assert [elect_region_aware(r, ks, single) for r in range(12)] == legacy
+
+
+def test_region_schedule_fairness_and_block_seams():
+    """Every member leads exactly once per |committee| rounds (the same
+    fairness bound as round-robin), the plurality region opens the
+    cycle, and the leader region changes only at the region-block
+    seams: #occupied-regions cross-region pivots per cycle."""
+    cmt = _committee(_keys(8))
+    ks = cmt.sorted_keys()
+    labels = ["west", "west", "west", "east", "east", "ap", "ap", "eu"]
+    regions = _region_map(ks, labels)
+    cycle = [elect_region_aware(r, ks, regions) for r in range(len(ks))]
+    assert sorted(cycle, key=lambda pk: pk.data) == ks  # once each
+    assert regions[cycle[0]] == "west"  # plurality region first
+    seq = [regions[pk] for pk in cycle]
+    seams = sum(1 for a, b in zip(seq, seq[1:] + seq[:1]) if a != b)
+    assert seams == len(set(labels))
+    # members are contiguous per region — no interleaving anywhere
+    assert len([1 for a, b in zip(seq, seq[1:]) if a != b]) == len(set(labels)) - 1
+
+
+# ---------------------------------------------------------------------------
+# Elector determinism: restart, epoch boundary, SafetyChecker pin
+
+
+def test_elector_restart_is_bit_identical():
+    """Two independently constructed electors over the same committee
+    and map (a node restart) must agree on every round — the schedule
+    carries no mutable runtime state."""
+    cmt = _committee(_keys(8))
+    regions = _region_map(
+        cmt.sorted_keys(), ["a", "a", "a", "b", "b", "c", "c", "c"]
+    )
+    first = RegionAwareElector(cmt, region_of=regions)
+    restarted = RegionAwareElector(cmt, region_of=regions)
+    schedule = [first.get_leader(r) for r in range(200)]
+    assert schedule == [restarted.get_leader(r) for r in range(200)]
+    # and both match the pure rule verbatim (the SafetyChecker contract)
+    ks = cmt.sorted_keys()
+    assert schedule == [
+        elect_region_aware(r, ks, regions) for r in range(200)
+    ]
+
+
+def test_elector_epoch_boundary_is_bit_identical():
+    """Across an epoch activation the rotation re-derives from the NEW
+    committee at exactly the boundary round, and a restarted elector
+    that re-applies the same epoch history lands on the identical
+    schedule."""
+    all_keys = _keys(6)
+    genesis = _committee(all_keys[:4])
+    epoch2 = _committee(all_keys[2:], epoch=2)
+    regions = {
+        pk: label
+        for pk, label in zip(all_keys, ["a", "a", "b", "b", "c", "c"])
+    }
+    boundary = 20
+
+    def build():
+        e = RegionAwareElector(genesis, region_of=regions)
+        assert e._epochs.schedule.apply(boundary, epoch2)
+        return e
+
+    a, b = build(), build()
+    schedule = [a.get_leader(r) for r in range(2 * boundary)]
+    assert schedule == [b.get_leader(r) for r in range(2 * boundary)]
+    g_keys, e2_keys = genesis.sorted_keys(), epoch2.sorted_keys()
+    for r, leader in enumerate(schedule):
+        expect_keys = g_keys if r < boundary else e2_keys
+        assert leader == elect_region_aware(r, expect_keys, regions), r
+    departed = set(g_keys) - set(e2_keys)
+    assert not departed & set(schedule[boundary:])  # left the rotation
+
+
+def test_safety_checker_derives_the_same_schedule():
+    """The chaos auditor's independent derivation (chaos/invariants.py
+    expected_leader) must agree with the fleet's elector round for
+    round — the split hazard the determinism rules exist to prevent."""
+    from hotstuff_tpu.chaos.invariants import SafetyChecker
+
+    cmt = _committee(_keys(8))
+    regions = _region_map(
+        cmt.sorted_keys(), ["a", "a", "b", "b", "b", "c", "c", "a"]
+    )
+    elector = RegionAwareElector(cmt, region_of=regions)
+    checker = SafetyChecker(cmt, region_of=regions, region_aware=True)
+    for r in range(3 * 8):
+        assert checker.expected_leader(r) == elector.get_leader(r), r
+    blind = SafetyChecker(cmt)
+    legacy = LeaderElector(cmt)
+    for r in range(3 * 8):
+        assert blind.expected_leader(r) == legacy.get_leader(r), r
+
+
+# ---------------------------------------------------------------------------
+# Construction-time fallback order: measured RTTs -> seeded map -> RR
+
+
+def test_elector_fallback_order():
+    cmt = _committee(_keys(4))
+    ks = cmt.sorted_keys()
+    # Seeded map says 3+1; full-coverage measurements say 2+2 (first two
+    # keys close, last two close, 150 ms across) — measurements win.
+    seeded = _region_map(ks, ["x", "x", "x", "y"])
+    rtt = {
+        ks[0]: {ks[1]: 4.0, ks[2]: 150.0, ks[3]: 150.0},
+        ks[2]: {ks[3]: 4.0, ks[0]: 150.0, ks[1]: 150.0},
+    }
+    measured = RegionAwareElector(cmt, region_of=seeded, measured_rtts=rtt)
+    groups = {}
+    for pk, label in measured.regions.items():
+        groups.setdefault(label, set()).add(pk)
+    assert {frozenset(g) for g in groups.values()} == {
+        frozenset(ks[:2]),
+        frozenset(ks[2:]),
+    }
+    # Partial coverage (one authority never measured): measurements are
+    # REJECTED wholesale — different nodes would hold different maps and
+    # split the schedule — and the seeded map stays in effect.
+    partial = {ks[0]: {ks[1]: 4.0, ks[2]: 150.0}}
+    fallback = RegionAwareElector(cmt, region_of=seeded, measured_rtts=partial)
+    assert fallback.regions == seeded
+    # Neither source: plain round-robin, bit-identical to the legacy seam.
+    bare = RegionAwareElector(cmt)
+    legacy = LeaderElector(cmt)
+    assert [bare.get_leader(r) for r in range(12)] == [
+        legacy.get_leader(r) for r in range(12)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Weighted WanMatrix seats (chaos/plan.py)
+
+
+def test_wan_matrix_weighted_seats_largest_remainder():
+    from hotstuff_tpu.chaos.plan import SeededRng, WanMatrix
+
+    wan = WanMatrix(weights=(0.4, 0.3, 0.2, 0.1))
+    rng = SeededRng(7).stream("wan")
+    assigned = wan.assign(rng, 64)
+    counts = {r: assigned.count(r) for r in wan.regions}
+    assert sorted(counts.values(), reverse=True) == [26, 19, 13, 6]
+    # same seed -> same assignment; different seed -> same SEATS, for
+    # the shuffle only permutes which node sits where
+    again = wan.assign(SeededRng(7).stream("wan"), 64)
+    assert assigned == again
+    other = wan.assign(SeededRng(8).stream("wan"), 64)
+    assert {r: other.count(r) for r in wan.regions} == counts
+    # n=4 under 40/30/20/10: 2/1/1/0 — the lightest region sits empty
+    small = wan.assign(SeededRng(7).stream("wan"), 4)
+    assert sorted(small.count(r) for r in wan.regions) == [0, 1, 1, 2]
+
+
+def test_wan_matrix_unweighted_assign_unchanged():
+    """weights=None must keep the committed balanced round-robin
+    assignment BIT-IDENTICAL — every pre-§5.5p matrix cell replays on
+    this path."""
+    from hotstuff_tpu.chaos.plan import SeededRng, WanMatrix
+
+    wan = WanMatrix()
+    rng = SeededRng(3).stream("wan")
+    order = list(wan.regions)
+    SeededRng(3).stream("wan").shuffle(order)
+    assert wan.assign(rng, 10) == [order[i % len(order)] for i in range(10)]
+    with pytest.raises(ValueError):
+        WanMatrix(weights=(1.0, 2.0))  # wrong arity
+    with pytest.raises(ValueError):
+        WanMatrix(weights=(1.0, -1.0, 1.0, 1.0))  # non-positive
+
+
+# ---------------------------------------------------------------------------
+# The wan_election grid cell (in-cell A/B vs "wan_election_blind")
+
+
+def test_wan_election_scenario_holds_its_pins():
+    """One tier-1 run of the region-aware arm at n=4: green under its
+    own expectation (which replays the region-blind twin in-cell), the
+    per-node election counters partition the committed rounds, and the
+    aware arm never crosses regions more often than round-robin."""
+    from hotstuff_tpu.chaos.scenarios import run_scenario
+
+    report = run_scenario("wan_election", seed=11)
+    assert report["ok"], report.get("expectation_failures") or report
+    m = report["metrics"]
+    rounds = m["elect.rounds"]
+    assert rounds > 0
+    assert m["elect.leader_region_matches"] + m["elect.cross_region_hops"] == rounds
+    assert m["elect.cross_region_hops"] <= m["elect.cross_region_hops_blind"]
+    # n=4 runs exact crypto: the trusted stub is a >=16-node concession
+    assert report["crypto_mode"] == "exact"
+
+
+@pytest.mark.slow
+def test_wan_election_replays_bit_identically():
+    """Same-seed bit-identity for the region-aware schedule under the
+    weighted WAN geometry: fault trace, commit sequences, event log,
+    AND the election counters replay exactly. (Elector-level restart
+    determinism stays tier-1 above; this pins the full fleet path.)"""
+    from hotstuff_tpu.chaos.scenarios import run_scenario
+
+    a = run_scenario("wan_election", seed=42)
+    b = run_scenario("wan_election", seed=42)
+    assert a["fault_trace"] == b["fault_trace"]
+    assert a["commits"] == b["commits"]
+    assert a["events"] == b["events"]
+    for key in (
+        "elect.rounds",
+        "elect.leader_region_matches",
+        "elect.cross_region_hops",
+        "elect.cross_region_hops_blind",
+    ):
+        assert a["metrics"].get(key) == b["metrics"].get(key), key
+
+
+# ---------------------------------------------------------------------------
+# Attribution surfaces: fleet_rollup, LogParser, trace_report
+
+
+def test_fleet_rollup_election_block_and_absence():
+    from hotstuff_tpu.utils.telemetry import fleet_rollup
+
+    base = {"nodes": 4, "virtual_seconds": 10.0, "ok": True, "commits": {}}
+    rollup = fleet_rollup(
+        {
+            **base,
+            "metrics": {
+                "elect.rounds": 200,
+                "elect.leader_region_matches": 150,
+                "elect.cross_region_hops": 50,
+                "elect.cross_region_hops_blind": 150,
+            },
+        }
+    )
+    e = rollup["election"]
+    assert e["rounds"] == 200 and e["match_rate"] == 0.75
+    assert e["hops_per_commit"] == 0.25
+    assert e["blind_hops_per_commit"] == 0.75
+    # no elect.rounds delta -> absence, not a zero claim
+    assert fleet_rollup({**base, "metrics": {}})["election"] is None
+
+
+def test_fleet_rollup_peer_rtt_partial_coverage_withholds_regions():
+    """With a partial RTT mesh the union-find would misread missing
+    links as region splits: the rollup must keep the raw columns but
+    emit None for every inference column, plus the coverage fraction
+    saying why."""
+    from hotstuff_tpu.utils.telemetry import fleet_rollup
+
+    base = {"nodes": 3, "virtual_seconds": 10.0, "ok": True, "commits": {}}
+    partial = {
+        "0": {"1": {"rtt_ewma_ms": 62.0}},
+        "1": {"0": {"rtt_ewma_ms": 62.0}},
+    }
+    pr = fleet_rollup({**base, "peers": partial, "metrics": {}})["peer_rtt"]
+    assert pr["links"] == 2 and pr["coverage"] == pytest.approx(2 / 6, abs=1e-3)
+    assert pr["region_count"] is None
+    assert pr["inferred_regions"] is None
+    assert pr["worst_cross_region_ewma_ms"] is None
+    assert pr["worst_ewma_ms"] == 62.0
+    # no RTT rows at all -> the whole section is absent
+    assert fleet_rollup({**base, "peers": {}, "metrics": {}})["peer_rtt"] is None
+
+
+_ELECTION_LINE = (
+    "[2026-08-06T10:00:05.000Z INFO hotstuff.consensus] Election plane: "
+    "{r} round(s) committed, {m} co-located pivot(s), {h} cross-region "
+    "hop(s), {b} blind\n"
+)
+
+
+def test_log_parser_election_section():
+    from benchmark.logs import LogParser
+    from tests.test_harness import CLIENT_LOG, NODE_LOG
+
+    node_a = NODE_LOG + _ELECTION_LINE.format(r=64, m=60, h=4, b=48)
+    node_b = NODE_LOG + _ELECTION_LINE.format(r=64, m=58, h=6, b=50)
+    p = LogParser([CLIENT_LOG], [node_a, node_b])
+    assert p.elect_rounds == 128 and p.elect_nodes == 2
+    assert p.elect_matches == 118 and p.elect_hops == 10
+    out = p.result()
+    assert "+ ELECTION:" in out
+    assert "128 committed round(s) across 2 node(s)" in out
+    assert "0.078/commit vs 0.766 under round-robin" in out
+    # the line is cumulative: only each node's LAST report counts
+    p2 = LogParser(
+        [CLIENT_LOG],
+        [node_a + _ELECTION_LINE.format(r=128, m=120, h=8, b=96)],
+    )
+    assert p2.elect_rounds == 128 and p2.elect_hops == 8
+    # no election lines -> no section
+    assert "+ ELECTION:" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+
+
+def test_trace_report_annotates_leader_region(tmp_path):
+    from tests.test_observatory import _synthetic_blocks
+
+    import trace_report
+
+    path = tmp_path / "report.json"
+    path.write_text(
+        json.dumps({"wan_regions": {"0": "us-east", "1": "eu-west"}})
+    )
+    regions = trace_report.load_wan_regions([str(path)])
+    assert regions == {"0": "us-east", "1": "eu-west"}
+    table = trace_report.critical_path_table(
+        _synthetic_blocks(), {"0": {"1": 224.0}}, regions
+    )
+    assert "0 @us-east" in table  # leader column names its region
+    assert "[cross-region]" in table
+    assert "cross-region propose hops: 1/1" in table
+    same = trace_report.critical_path_table(
+        _synthetic_blocks(), {"0": {"1": 224.0}}, {"0": "us-east", "1": "us-east"}
+    )
+    assert "[in-region]" in same and "propose hops: 0/1" in same
+    # region-less runs (empty wan_regions labels) render the old table
+    bare = trace_report.critical_path_table(
+        _synthetic_blocks(), {"0": {"1": 224.0}}
+    )
+    assert "@us-east" not in bare and "cross-region propose hops" not in bare

@@ -108,10 +108,13 @@ def test_safety_checker_audit_catches_corrupted_qc_under_stub():
         votes = tuple(
             (pk, Signature(pysigner.sign(s, signed))) for pk, s in keys[:3]
         )
+        # Authored by round 2's round-robin leader (sorted keys, index
+        # 2 mod 4): the checker now audits the election schedule on
+        # every commit, so a mis-authored block is a violation here.
         block = Block(
             QC(parent, 1, votes),
             None,
-            keys[0][0],
+            keys[2][0],
             2,
             (Digest(b"\x02" * 32),),
             Signature(bytes(64)),
@@ -126,7 +129,7 @@ def test_safety_checker_audit_catches_corrupted_qc_under_stub():
         bad_block = Block(
             QC(parent, 1, bad_votes),
             None,
-            keys[0][0],
+            keys[2][0],
             2,
             (Digest(b"\x03" * 32),),
             Signature(bytes(64)),

@@ -272,6 +272,65 @@ def lint_matrix() -> list[str]:
                         "covers every node — a rotation directive has no "
                         "join candidates to admit"
                     )
+    problems += _lint_wan_election_family(MATRIX_SCENARIOS, SCENARIOS)
+    return problems
+
+
+def _lint_wan_election_family(matrix_scenarios, scenarios) -> list[str]:
+    """The wan_election grid cell is a one-cell A/B: its expectation
+    replays the region-blind twin at the identical seed/size/window.
+    That comparison is only honest while (a) the twin resolves, (b) the
+    twin stays OUT of the standalone grid (it would double-run inside
+    every wan_election cell), (c) both arms share the same fault plan
+    and commit window, and (d) the arms' Parameters differ in the
+    election schedule alone — any other drift silently turns the pinned
+    hop/latency delta into an apples-to-oranges artifact the matrix
+    would still stamp GREEN."""
+    aware = scenarios.get("wan_election")
+    if aware is None:
+        return []
+    problems: list[str] = []
+    blind = scenarios.get("wan_election_blind")
+    if blind is None:
+        return [
+            "wan_election has no registered region-blind twin "
+            "'wan_election_blind' — its expectation's in-cell A/B replay "
+            "would fail every grid cell"
+        ]
+    if "wan_election_blind" in matrix_scenarios:
+        problems.append(
+            "wan_election_blind sits in MATRIX_SCENARIOS — the blind arm "
+            "already runs inside every wan_election cell; sweeping it "
+            "standalone doubles the grid cost for no new coverage"
+        )
+    if (blind.plan, blind.duration, blind.min_commits) != (
+        aware.plan,
+        aware.duration,
+        aware.min_commits,
+    ):
+        problems.append(
+            "wan_election A/B arms disagree on plan/duration/min_commits "
+            "— the in-cell replay would compare different fault windows"
+        )
+    a_params = aware.parameters().to_json()
+    b_params = blind.parameters().to_json()
+    if not a_params.pop("region_aware_election", False) or b_params.pop(
+        "region_aware_election", True
+    ):
+        problems.append(
+            "wan_election arms must differ in region_aware_election "
+            "(aware=True, blind=False) — that flag IS the treatment"
+        )
+    drift = sorted(
+        k
+        for k in set(a_params) | set(b_params)
+        if a_params.get(k) != b_params.get(k)
+    )
+    if drift:
+        problems.append(
+            f"wan_election A/B arms drift on parameters {drift} — the "
+            "election schedule must be the only varied bit"
+        )
     return problems
 
 

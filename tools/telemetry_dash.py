@@ -215,6 +215,14 @@ def cell_record(cell: dict, regression: dict) -> dict:
         "newly_red": name in (regression.get("newly_red") or ()),
         "rate_delta_pct": (regression.get("commit_rate_deltas") or {}).get(name),
         "violations": cell.get("violations") or {},
+        # Measurement-gated columns: None means UNMEASURED (partial/no
+        # RTT coverage, or a region-less run) and renders as '-' — never
+        # a fabricated count (utils/telemetry.fleet_rollup's coverage
+        # gate, §5.5p satellite).
+        "rtt_region_count": (rollup.get("peer_rtt") or {}).get("region_count"),
+        "pivot_hops_per_commit": (rollup.get("election") or {}).get(
+            "hops_per_commit"
+        ),
     }
 
 
@@ -229,8 +237,9 @@ def render_matrix(artifact: dict) -> str:
         f"{summary.get('red', '?')} red of {summary.get('cells', '?')} "
         f"cells; baseline: {regression.get('baseline') or '-'})\n",
         "| cell | crypto | verdict | commits | commit/s | rate Δ | "
-        "consensus p99 (ms) | worst occupancy | alerts | trace |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "consensus p99 (ms) | worst occupancy | alerts | trace | "
+        "regions | pivot hops |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in records:
         verdict = "GREEN" if r["green"] else "RED"
@@ -246,11 +255,15 @@ def render_matrix(artifact: dict) -> str:
             if isinstance(r["consensus_p99_ms"], (int, float))
             else "-"
         )
+        regions = r["rtt_region_count"]
+        hops = r["pivot_hops_per_commit"]
         lines.append(
             f"| {r['cell']} | {r['crypto']} | {verdict} | {r['commits']} "
             f"| {r['commit_rate']:.1f} | {delta} | {p99} "
             f"| {_fmt_pct(r['worst_occupancy'])} | {r['alerts_fired']} "
-            f"| {'TRUNCATED' if r['truncated'] else 'full'} |"
+            f"| {'TRUNCATED' if r['truncated'] else 'full'} "
+            f"| {regions if regions is not None else '-'} "
+            f"| {f'{hops:.3f}' if isinstance(hops, (int, float)) else '-'} |"
         )
     problems = [
         f"- {r['cell']}: {kind}: {msg}"

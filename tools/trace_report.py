@@ -151,6 +151,26 @@ def load_peer_rtts(paths: list[str]) -> dict[str, dict[str, float]]:
     return {label: row for label, row in rtts.items() if row}
 
 
+def load_wan_regions(paths: list[str]) -> dict[str, str]:
+    """Seed-derived WAN region per node from a chaos report's
+    `wan_regions` section (chaos/orchestrator.py `_report`): node label
+    -> region label. Empty labels (no WAN matrix on the run) are
+    dropped so the critical-path table annotates regions only when the
+    run actually modelled a geometry — per-node dump files carry no
+    region map and contribute nothing here."""
+    regions: dict[str, str] = {}
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for label, region in sorted((d.get("wan_regions") or {}).items()):
+            if region:
+                regions[str(label)] = str(region)
+    return regions
+
+
 def stage_times(nodes: list[dict]) -> dict:
     """block trace id -> {node -> {stage -> earliest aligned time}}."""
     blocks: dict[str, dict[str, dict[str, float]]] = {}
@@ -264,18 +284,28 @@ def critical_path(blocks: dict) -> dict[str, dict]:
     return out
 
 
-def critical_path_table(blocks: dict, rtts: dict | None = None) -> str:
+def critical_path_table(
+    blocks: dict,
+    rtts: dict | None = None,
+    regions: dict[str, str] | None = None,
+) -> str:
     """Markdown per-round critical-path attribution: each segment as
     `ms (share%) @gating-node`, plus the measured leader->gating-node
     half-RTT for the payload segment (the propose hop) when the input
     carried a peer RTT ledger — that separates wire propagation from
-    fetch/verify work inside the same segment."""
+    fetch/verify work inside the same segment. With a WAN region map
+    (a chaos report's `wan_regions`) each row also names the leader's
+    region and flags whether the propose hop crossed a region boundary
+    — the same pivot geometry the region-aware elector (§5.5p,
+    consensus/leader.py) exists to keep in-region."""
     paths = critical_path(blocks)
     if not paths:
         return ""
     rtts = rtts or {}
+    regions = regions or {}
     rows = []
     shares: dict[str, list[float]] = {s: [] for s in _CP_SEGMENTS}
+    hops_scored = hops_crossed = 0
     for trace, cp in paths.items():
         total = cp["total_s"]
         if total <= 0:
@@ -295,8 +325,17 @@ def critical_path_table(blocks: dict, rtts: dict | None = None) -> str:
         link = rtts.get(cp["leader"], {}).get(payload[3])
         if link is not None and payload[3] != cp["leader"]:
             hop = f"{link / 2.0:.1f} ({cp['leader']}->{payload[3]})"
+        leader_region = regions.get(cp["leader"])
+        gating_region = regions.get(payload[3])
+        if leader_region and gating_region and payload[3] != cp["leader"]:
+            crossed = leader_region != gating_region
+            hops_scored += 1
+            hops_crossed += crossed
+            hop += " [cross-region]" if crossed else " [in-region]"
+        leader = cp["leader"] + (f" @{leader_region}" if leader_region else "")
         rows.append(
-            f"| {trace} | r{_round_of(trace)} | {total * 1000.0:.1f} | "
+            f"| {trace} | r{_round_of(trace)} | {leader} | "
+            f"{total * 1000.0:.1f} | "
             + " | ".join(cells)
             + f" | {hop} |"
         )
@@ -307,15 +346,23 @@ def critical_path_table(blocks: dict, rtts: dict | None = None) -> str:
     }
     dominant = max(sorted(mean), key=lambda s: mean[s])
     head = " | ".join(_CP_SEGMENTS)
+    tail = ""
+    if hops_scored:
+        tail = (
+            f"\ncross-region propose hops: {hops_crossed}/{hops_scored} "
+            "region-attributed rounds"
+        )
     return (
         "### Per-round critical path (cross-node stage maxima; "
         "ms, share of total, gating node)\n\n"
-        f"| block | round | total (ms) | {head} | propose hop rtt/2 (ms) |\n"
-        "|---|---|---|" + "---|" * len(_CP_SEGMENTS) + "---|\n"
+        f"| block | round | leader | total (ms) | {head} "
+        "| propose hop rtt/2 (ms) |\n"
+        "|---|---|---|---|" + "---|" * len(_CP_SEGMENTS) + "---|\n"
         + "\n".join(rows)
         + "\n\nmean shares: "
         + ", ".join(f"{s} {mean[s] * 100.0:.0f}%" for s in _CP_SEGMENTS)
         + f" — dominant segment: {dominant}"
+        + tail
     )
 
 
@@ -735,7 +782,9 @@ def main(argv: list[str] | None = None) -> int:
     print()
     print(latency_table(blocks))
     for section in (
-        critical_path_table(blocks, load_peer_rtts(args.dumps)),
+        critical_path_table(
+            blocks, load_peer_rtts(args.dumps), load_wan_regions(args.dumps)
+        ),
         verify_lane_table(nodes),
         agg_bundle_table(nodes),
         ingress_leg_table(nodes),
