@@ -210,6 +210,18 @@ def _parse_node(text: str) -> dict:
     out["cert_plane"] = (
         tuple(int(x) for x in certs[-1]) if certs else None
     )
+    # Proof-plane line (proofs/server.py _serve): cumulative served /
+    # subscription / shed counts and the worst served proof's wire bytes.
+    # Cumulative per node, so the LAST line wins; absent on runs without
+    # the commit-proof serving plane.
+    served = _search_all(
+        r"Proof served: (\d+) proofs served, (\d+) subscriptions, "
+        r"(\d+) shed, worst proof (\d+) B",
+        text,
+    )
+    out["proof_plane"] = (
+        tuple(int(x) for x in served[-1]) if served else None
+    )
     # Election-plane line (consensus/core.py _note_election_stats): the
     # per-node cumulative propose->certify pivot attribution — rounds
     # scored, co-located pivots, cross-region hops, and the in-run
@@ -380,6 +392,14 @@ class LogParser:
         self.cert_worst_bytes = 0
         self.cert_depth = 0
         self.cert_nodes = 0
+        # Proof-plane fold (cumulative per-node lines, like the cert
+        # plane): served/subscription/shed counts sum across nodes; the
+        # worst proof's wire bytes take the max.
+        self.proof_served = 0
+        self.proof_subs = 0
+        self.proof_shed = 0
+        self.proof_worst_bytes = 0
+        self.proof_nodes = 0
         # Election-plane fold (cumulative per-node lines, like the cert
         # plane): counts sum across nodes, with the contributing node
         # count kept so per-commit rates stay honest.
@@ -441,6 +461,13 @@ class LogParser:
                 self.cert_worst_bytes = max(self.cert_worst_bytes, worst_b)
                 self.cert_depth = max(self.cert_depth, depth)
                 self.cert_nodes += 1
+            if r.get("proof_plane") is not None:
+                p_served, p_subs, p_shed, p_worst = r["proof_plane"]
+                self.proof_served += p_served
+                self.proof_subs += p_subs
+                self.proof_shed += p_shed
+                self.proof_worst_bytes = max(self.proof_worst_bytes, p_worst)
+                self.proof_nodes += 1
             if r.get("election") is not None:
                 e_rounds, e_matches, e_hops, e_blind = r["election"]
                 self.elect_rounds += e_rounds
@@ -739,6 +766,21 @@ class LogParser:
                 f" Worst cert: {self.cert_worst_bytes:,} B,"
                 f" aggregation depth {self.cert_depth}\n"
             )
+        proofs = ""
+        if self.proof_nodes:
+            shed_pct = (
+                100.0 * self.proof_shed / (self.proof_subs + self.proof_shed)
+                if (self.proof_subs + self.proof_shed)
+                else 0.0
+            )
+            proofs = (
+                " + PROOFS:\n"
+                f" Proofs served: {self.proof_served:,}"
+                f" across {self.proof_nodes} node(s)"
+                f" ({self.proof_subs:,} subscriptions,"
+                f" {self.proof_shed:,} shed = {shed_pct:.1f} %)\n"
+                f" Worst proof: {self.proof_worst_bytes:,} B\n"
+            )
         election = ""
         if self.elect_nodes and self.elect_rounds:
             match_pct = 100.0 * self.elect_matches / self.elect_rounds
@@ -840,6 +882,7 @@ class LogParser:
             + matrix
             + agg
             + certs
+            + proofs
             + election
             + reconfig
             + mtr

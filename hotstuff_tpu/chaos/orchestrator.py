@@ -26,6 +26,7 @@ import os
 import tempfile
 import time
 
+from collections import deque
 from dataclasses import dataclass
 
 from ..consensus import Consensus
@@ -137,11 +138,19 @@ class BulkFlood:
 class DeterministicMempool:
     """MockMempool with a per-node seeded stream: answers Get with one
     deterministic payload digest, Verify with ACCEPT (the consensus plane
-    under test orders digests; payload dissemination has its own tests)."""
+    under test orders digests; payload dissemination has its own tests).
 
-    def __init__(self, rng) -> None:
+    With a `pending` deque wired (the proof-plane scenarios), admitted
+    ingress transaction digests are served AS the payload digest instead
+    of a random one — the chaos analogue of the real PayloadMaker path,
+    where the digest a client can later prove commitment of actually
+    rides a block. One digest per Get, mirroring the baseline shape (and
+    keeping CommitProofs at the single-payload ~300 B pin)."""
+
+    def __init__(self, rng, pending: deque | None = None) -> None:
         self.channel = channel()
         self._rng = rng
+        self._pending = pending
 
     def start(self) -> None:
         spawn(self._run(), name="chaos-mempool")
@@ -150,7 +159,10 @@ class DeterministicMempool:
         while True:
             msg = await self.channel.get()
             if isinstance(msg, MempoolGet):
-                msg.reply.set_result([Digest(self._rng.randbytes(32))])
+                if self._pending:
+                    msg.reply.set_result([self._pending.popleft()])
+                else:
+                    msg.reply.set_result([Digest(self._rng.randbytes(32))])
             elif isinstance(msg, MempoolVerify):
                 msg.reply.set_result(PayloadStatus.ACCEPT)
             elif isinstance(msg, MempoolCleanup):
@@ -160,7 +172,8 @@ class DeterministicMempool:
 class _NodeHandle:
     __slots__ = (
         "index", "pk", "seed", "store_path", "scope", "store", "service",
-        "policy", "running", "core", "epochs",
+        "policy", "running", "core", "epochs", "proof_registry",
+        "proof_service",
     )
 
     def __init__(self, index: int, pk: PublicKey, seed: bytes, store_path: str | None):
@@ -175,6 +188,8 @@ class _NodeHandle:
         self.running = False
         self.core = None  # consensus Core (reconfig directives target it)
         self.epochs: EpochManager | None = None  # this incarnation's view
+        self.proof_registry = None  # proofs.ProofRegistry (proofs runs)
+        self.proof_service = None  # proofs.ProofService over the registry
 
 
 class ChaosOrchestrator:
@@ -194,6 +209,8 @@ class ChaosOrchestrator:
         reconfig: "ReconfigDirective | list[ReconfigDirective] | None" = None,
         boundary_crashes: "list[BoundaryCrash] | None" = None,
         trusted_crypto: bool = False,
+        proofs: bool = False,
+        proof_squat_rate: float = 0.0,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -315,6 +332,29 @@ class ChaosOrchestrator:
         self.ingress_drivers: list[tuple[int, object]] = []  # (node, loadgen)
         self.flood = flood
         self.flood_stats: dict[int, dict] = {}  # node -> driver counters
+        # Commit-proof serving plane (§5.5q): with proofs=True every node
+        # boots a ProofRegistry wired into its Core, admitted ingress tx
+        # digests feed the target's DeterministicMempool (so accepted
+        # transactions really ride blocks), and one proof-tracking client
+        # per admitted tx subscribes-until-commit and STATELESSLY verifies
+        # the served CommitProof against the genesis committee. The
+        # pending-digest deques outlive node incarnations (external load
+        # keeps queuing at a crashed node, like the ingress drivers).
+        self.proofs_enabled = bool(proofs)
+        self.proof_squat_rate = float(proof_squat_rate)
+        self._proof_pending: dict[int, deque] = {
+            i: deque(maxlen=8_192) for i in range(n)
+        }
+        self.proof_stats: dict[int, dict] = {}
+        self.squat_stats: dict[int, dict] = {}
+        # (client, nonce, tx digest) per tracked admission — the source of
+        # truth the end-of-run provability audit replays against the
+        # registry (unproved_committed must come out zero).
+        self._proof_tracked: dict[int, list] = {}
+        # Certificate-verification dedup: proofs from one committed block
+        # share one cert; crypto-verify it once, re-check only the cheap
+        # digest binding per proof (bounds exact-BLS wall cost).
+        self._verified_certs: set[tuple[bytes, int]] = set()
         # Per-node scheduler knobs (e.g. the virtual device-occupancy pace
         # the bulk_flood_priority scenario needs); None = defaults.
         self.scheduler_config = scheduler_config
@@ -402,9 +442,26 @@ class ChaosOrchestrator:
                 node.store = Store(node.store_path)
                 sig_service = pysigner.PySignatureService(node.seed)
                 mempool = DeterministicMempool(
-                    self.rng.stream(f"mempool:{i}")
+                    self.rng.stream(f"mempool:{i}"),
+                    pending=(
+                        self._proof_pending[i] if self.proofs_enabled else None
+                    ),
                 )
                 mempool.start()
+                if self.proofs_enabled:
+                    # Fresh registry per incarnation against the node's
+                    # persisted store: a restart reloads the newest proof
+                    # window exactly like a real node boot. The service
+                    # wrapper is re-resolved through the handle by the
+                    # run-scope proof clients, so they survive restarts.
+                    from ..proofs import ProofRegistry, ProofService
+
+                    node.proof_registry = ProofRegistry(store=node.store)
+                    node.proof_service = ProofService(node.proof_registry)
+                    spawn(
+                        node.proof_registry.load(),
+                        name=f"chaos-proof-load-{i}",
+                    )
                 node.service = BatchVerificationService(
                     inline=True, scheduler_config=self.scheduler_config
                 )
@@ -434,6 +491,7 @@ class ChaosOrchestrator:
                         if self.agg_scheme is not None
                         else None
                     ),
+                    proof_registry=node.proof_registry,
                 )
                 spawn(self._drain(i, commit_channel), name=f"chaos-drain-{i}")
         finally:
@@ -477,8 +535,25 @@ class ChaosOrchestrator:
                 pipeline = IngressPipeline(
                     node.service, sink, config=self.ingress.config()
                 )
+                submit = pipeline.submit
+                if self.proofs_enabled:
+                    # Close the submit → commit → proof loop: every
+                    # ACCEPTED response also feeds the tx digest to this
+                    # node's DeterministicMempool and spawns a proof-
+                    # tracking client (run scope — external observers).
+                    self.proof_stats[i] = {
+                        "tracked": 0,
+                        "served": 0,
+                        "verified_ok": 0,
+                        "verify_failed": 0,
+                        "retries": 0,
+                        "proof_bytes_max": 0,
+                        "latencies_s": [],
+                    }
+                    self._proof_tracked[i] = []
+                    submit = self._wrap_proof_submit(i, pipeline.submit)
                 gen = OpenLoopLoadGen(
-                    pipeline.submit,
+                    submit,
                     curve=self.ingress.curve,
                     duration=self.ingress.duration,
                     clients=self.ingress.clients,
@@ -494,6 +569,201 @@ class ChaosOrchestrator:
     async def _drain_ingress(self, sink: asyncio.Queue) -> None:
         while True:
             await sink.get()
+
+    # -- commit-proof serving plane (§5.5q) ----------------------------------
+
+    def _wrap_proof_submit(self, i: int, submit):
+        """Decorate a pipeline's submit: ACCEPTED admissions enter the
+        proof loop — registry note, payload-digest feed, tracking client."""
+        from ..ingress import messages as ingress_messages
+
+        async def wrapped(tx):
+            resp = await submit(tx)
+            if resp.status == ingress_messages.ACCEPTED:
+                self._on_proof_admit(i, tx)
+            return resp
+
+        return wrapped
+
+    def _on_proof_admit(self, i: int, tx) -> None:
+        node = self.nodes[i]
+        digest = tx.digest()
+        if node.proof_registry is not None:
+            node.proof_registry.note_tx(tx.client, tx.nonce, digest)
+        # The digest rides the node's next proposal (DeterministicMempool
+        # serves the pending deque before its random stream) — the chaos
+        # analogue of PayloadMaker flushing admitted bodies into a batch.
+        self._proof_pending[i].append(digest)
+        stats = self.proof_stats[i]
+        stats["tracked"] += 1
+        self._proof_tracked[i].append((tx.client, tx.nonce, digest))
+        spawn(
+            self._track_proof(
+                i, tx.client, tx.nonce, digest,
+                asyncio.get_running_loop().time(),
+            ),
+            name=f"chaos-proof-track-{i}-{stats['tracked']}",
+        )
+
+    async def _track_proof(self, i, client, nonce, digest, t0) -> None:
+        """One proof-tracking client per admitted tx: subscribe-until-
+        commit against the serving node, honor shed/pending retry hints,
+        then verify the served CommitProof STATELESSLY — wire round-trip
+        included — against the genesis committee's public keys."""
+        from ..proofs import (
+            MODE_SUBSCRIBE,
+            PROOF_OK,
+            ProofQuery,
+            decode_proof_message,
+            encode_proof_message,
+        )
+
+        stats = self.proof_stats[i]
+        loop = asyncio.get_running_loop()
+        while True:
+            node = self.nodes[i]
+            service = node.proof_service
+            if not node.running or service is None:
+                await asyncio.sleep(0.25)
+                continue
+            # Re-assert the admission with the CURRENT incarnation's
+            # registry: a restart rebuilt it from the persisted proof
+            # window, and the (client, nonce) -> digest row is client-
+            # session state, not chain state.
+            node.proof_registry.note_tx(client, nonce, digest)
+            query = ProofQuery(client, nonce, MODE_SUBSCRIBE)
+            try:
+                reply = await asyncio.wait_for(
+                    service.handle(query, loop.time()), timeout=3.0
+                )
+            except asyncio.TimeoutError:
+                # Parked past the patience window (e.g. the node crashed
+                # under us): wait_for cancelled the subscription — which
+                # released its waiter slot — so just resubscribe.
+                stats["retries"] += 1
+                continue
+            if reply.status == PROOF_OK:
+                break
+            stats["retries"] += 1
+            await asyncio.sleep(max(reply.retry_after_ms, 50) / 1000.0)
+        # The client's view of the wire: encode the reply envelope, decode
+        # it back, and verify the DECODED proof — the in-process chaos run
+        # exercises the exact byte path a TCP client would see.
+        reply = decode_proof_message(encode_proof_message(reply))
+        proof = reply.proof
+        stats["served"] += 1
+        stats["latencies_s"].append(loop.time() - t0)
+        stats["proof_bytes_max"] = max(
+            stats["proof_bytes_max"], proof.encoded_size()
+        )
+        if self._verify_proof(proof, digest):
+            stats["verified_ok"] += 1
+        else:
+            stats["verify_failed"] += 1
+
+    def _verify_proof(self, proof, payload_digest) -> bool:
+        """Stateless client verification with per-block cert dedup: all
+        proofs from one committed block share one certificate, so the
+        quorum crypto is checked once per block and every proof after
+        that re-runs only the digest-binding + membership checks (bounds
+        exact-BLS wall cost without weakening any individual proof)."""
+        from ..proofs import ProofVerificationError
+
+        key = (proof.cert.hash.data, proof.cert.round)
+        try:
+            if key in self._verified_certs:
+                if proof.cert.hash != proof.block_digest():
+                    return False
+                if proof.cert.round != proof.round:
+                    return False
+                return payload_digest in proof.payload
+            proof.verify(self.committee, payload_digest=payload_digest)
+        except (ProofVerificationError, ValueError, KeyError):
+            return False
+        if len(self._verified_certs) >= 65_536:
+            self._verified_certs.clear()
+        self._verified_certs.add(key)
+        return True
+
+    def _boot_proof_squatters(self) -> None:
+        """Byzantine nonce-squatting clients: subscribe for (client,
+        nonce) pairs that were NEVER admitted, at `proof_squat_rate`
+        queries/s per target. The server must shed every one with a retry
+        hint and allocate NOTHING — the bounded-registry pin."""
+        targets = (
+            list(self.ingress.targets)
+            if self.ingress is not None and self.ingress.targets is not None
+            else list(self.honest)
+        )
+        for i in targets:
+            stats = {"sent": 0, "shed": 0, "other": 0}
+            self.squat_stats[i] = stats
+            spawn(
+                self._squat_node(i, self.rng.stream(f"proof-squat:{i}"), stats),
+                name=f"chaos-proof-squat-{i}",
+            )
+
+    async def _squat_node(self, i: int, rng, stats: dict) -> None:
+        from ..proofs import MODE_SUBSCRIBE, PROOF_SHED, ProofQuery
+
+        loop = asyncio.get_running_loop()
+        interval = 1.0 / self.proof_squat_rate
+        while True:
+            node = self.nodes[i]
+            service = node.proof_service
+            if node.running and service is not None:
+                client = PublicKey(rng.randbytes(32))
+                nonce = int.from_bytes(rng.randbytes(5), "little")
+                stats["sent"] += 1
+                try:
+                    reply = await asyncio.wait_for(
+                        service.handle(
+                            ProofQuery(client, nonce, MODE_SUBSCRIBE),
+                            loop.time(),
+                        ),
+                        timeout=3.0,
+                    )
+                    if reply.status == PROOF_SHED:
+                        stats["shed"] += 1
+                    else:
+                        stats["other"] += 1
+                except asyncio.TimeoutError:
+                    stats["other"] += 1
+            await asyncio.sleep(interval)
+
+    def _proof_summary(self, i: int) -> dict:
+        stats = self.proof_stats[i]
+        node = self.nodes[i]
+        registry = node.proof_registry
+        # End-of-run provability audit: a tracked tx whose digest the
+        # registry COMMITTED (proof_for_payload hit) but whose (client,
+        # nonce) key never resolved would be an admitted-and-committed tx
+        # a client cannot prove — the invariant the scenario pins to zero.
+        unproved = 0
+        if registry is not None:
+            for client, nonce, digest in self._proof_tracked.get(i, ()):
+                proof, _known = registry.proof_for_client(client, nonce)
+                if proof is None and registry.proof_for_payload(digest):
+                    unproved += 1
+        lat_ms = [s * 1000.0 for s in stats["latencies_s"]]
+        pct = metrics.percentile
+        return {
+            "tracked": stats["tracked"],
+            "served": stats["served"],
+            "verified_ok": stats["verified_ok"],
+            "verify_failed": stats["verify_failed"],
+            "retries": stats["retries"],
+            "pending": stats["tracked"] - stats["served"],
+            "unproved_committed": unproved,
+            "proof_bytes_max": stats["proof_bytes_max"],
+            "registry_size": registry.size() if registry is not None else 0,
+            "latency_ms": {
+                "count": len(lat_ms),
+                "p50": round(pct(lat_ms, 0.50), 3),
+                "p99": round(pct(lat_ms, 0.99), 3),
+                "max": round(max(lat_ms), 3) if lat_ms else 0.0,
+            },
+        }
 
     def _boot_telemetry(self, loop) -> None:
         """One TelemetryPlane per node on the VIRTUAL clock. Planes live
@@ -855,6 +1125,8 @@ class ChaosOrchestrator:
                         self._boot(i)
                 if self.ingress is not None:
                     self._boot_ingress()
+                if self.proofs_enabled and self.proof_squat_rate > 0:
+                    self._boot_proof_squatters()
                 if self.flood is not None:
                     self._boot_flood()
                 if self.telemetry_config is not None:
@@ -950,6 +1222,20 @@ class ChaosOrchestrator:
             # Per-node bulk-flood driver counters (BulkFlood scenarios).
             "flood": {
                 str(i): dict(stats) for i, stats in self.flood_stats.items()
+            },
+            # Commit-proof serving plane (§5.5q): per-target tracking-
+            # client outcomes — served/verified counts, submit→proof-in-
+            # hand latency percentiles, worst proof size, and the end-of-
+            # run provability audit (unproved_committed must be zero).
+            "proofs": {
+                str(i): self._proof_summary(i)
+                for i in sorted(self.proof_stats)
+            },
+            # Byzantine nonce-squatting drivers: every never-admitted
+            # subscription must come back SHED (allocation-free).
+            "proof_squat": {
+                str(i): dict(stats)
+                for i, stats in sorted(self.squat_stats.items())
             },
             # Per-node live-telemetry dumps (snapshot ring + SLO burn
             # alerts — utils/telemetry.py). `commits` is overwritten with

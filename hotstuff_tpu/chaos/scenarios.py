@@ -140,6 +140,15 @@ class Scenario:
     # size the constant-size-certificate claim is about — without
     # tripling every legacy scenario's cell count.
     matrix_sizes: tuple[int, ...] | None = None
+    # Commit-proof serving plane (§5.5q): boot a ProofRegistry +
+    # ProofService per node, feed admitted ingress tx digests into that
+    # node's proposals, and attach one subscribe-until-commit proof
+    # client per ACCEPTED transaction — outcomes land in the report's
+    # `proofs` section (requires `ingress`).
+    proofs: bool = False
+    # Byzantine nonce-squatting driver: never-admitted MODE_SUBSCRIBE
+    # queries/s per target node (0 = off); outcomes in `proof_squat`.
+    proof_squat_rate: float = 0.0
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -1016,6 +1025,172 @@ _register(
 )
 
 
+# Commit-proof serving (§5.5q): worst-case CommitProof wire size for a
+# single-payload block — version byte, 32 B author, u64 round, one-digest
+# payload seq, 32 B parent hash + u64 parent round, epoch flag, and the
+# aggregate certificate (flat core + the ceil(n/8)-byte committee
+# bitmap). Size-parameterized like the certificate bound: the O(1)
+# claim is "flat modulo the bitmap", not "flat including it".
+PROOF_BYTES_CORE = 310
+
+
+def _proof_bytes_bound(n: int) -> int:
+    return PROOF_BYTES_CORE + ((n + 7) // 8)
+
+
+def _proof_totals(report: dict) -> dict:
+    totals = {
+        "tracked": 0, "served": 0, "verified_ok": 0, "verify_failed": 0,
+        "unproved_committed": 0, "proof_bytes_max": 0,
+    }
+    for summary in report.get("proofs", {}).values():
+        for k in totals:
+            if k == "proof_bytes_max":
+                totals[k] = max(totals[k], summary.get(k, 0))
+            else:
+                totals[k] += summary.get(k, 0)
+    return totals
+
+
+def _expect_ingress_proofs(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "proofs.indexed")
+    problems += _expect_counter(deltas, "proofs.resolved")
+    problems += _expect_counter(deltas, "proofs.served", minimum=4)
+    if deltas.get("proofs.cert_mismatch", 0):
+        problems.append(
+            f"{deltas['proofs.cert_mismatch']} commit notes carried a "
+            "certificate that did not certify the committed block"
+        )
+    totals = _proof_totals(report)
+    if not totals["tracked"]:
+        problems.append("no admitted transaction entered the proof loop")
+    if totals["served"] < 4:
+        problems.append(
+            f"only {totals['served']} proofs reached a client in hand "
+            "(floor 4) — the submit→commit→proof loop barely closed"
+        )
+    # EVERY served proof must verify statelessly at the client; a
+    # committed-and-indexed tx whose key never resolved would be an
+    # admitted-and-committed tx its client cannot prove.
+    if totals["verify_failed"]:
+        problems.append(
+            f"{totals['verify_failed']} served proofs FAILED stateless "
+            "client verification"
+        )
+    if totals["verified_ok"] != totals["served"]:
+        problems.append(
+            f"{totals['verified_ok']} verified of {totals['served']} served"
+        )
+    if totals["unproved_committed"]:
+        problems.append(
+            f"{totals['unproved_committed']} committed transactions are "
+            "not provable by their client (registry resolution hole)"
+        )
+    bound = _proof_bytes_bound(report["nodes"])
+    if totals["proof_bytes_max"] > bound:
+        problems.append(
+            f"worst served proof {totals['proof_bytes_max']} B exceeds the "
+            f"O(1) bound {bound} B at n={report['nodes']}"
+        )
+    return problems
+
+
+def _proofs_ingress_config() -> IngressConfig:
+    # Generous default lanes + a fast verify tick: this scenario pins the
+    # proof loop, not admission overload (flash_crowd_ingress owns that).
+    return IngressConfig(verify_batch=4, verify_interval=0.05)
+
+
+def _proofs_ingress_load() -> IngressLoad:
+    return IngressLoad(
+        curve=ArrivalCurve(kind="sustained", rate=2),
+        duration=10.0,
+        clients=2,
+        tx_bytes=32,
+        config=_proofs_ingress_config,
+    )
+
+
+_register(
+    Scenario(
+        name="ingress_proofs",
+        description="Commit-proof serving plane (§5.5q): open-loop clients "
+        "submit through every node's authenticated ingress, each ACCEPTED "
+        "digest rides that node's next proposal, and a proof client "
+        "subscribes until commit — every served CommitProof must verify "
+        "STATELESSLY against the committee keys alone, stay within the "
+        "bitmap-parameterized O(1) byte bound, and no admitted-and-"
+        "committed transaction may end the run unprovable.",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        parameters=_agg_cert_params,
+        trusted_crypto=True,
+        duration=14.0,
+        cell_duration=14.0,  # the loop plays out in 14 s at every size
+        min_commits=0,  # no early stop: the 4 s post-load tail must play out
+        ingress=_proofs_ingress_load,
+        proofs=True,
+        expect=_expect_ingress_proofs,
+    )
+)
+
+
+def _expect_proof_squatter(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "proofs.subs_shed", minimum=200)
+    sent = shed = 0
+    for s in report.get("proof_squat", {}).values():
+        sent += s.get("sent", 0)
+        shed += s.get("shed", 0)
+    if sent < 200:
+        problems.append(f"squat driver barely ran: {sent} subscriptions")
+    if shed != sent:
+        problems.append(
+            f"only {shed} of {sent} never-admitted subscriptions were shed "
+            "(a squatter must never park a waiter or earn a proof)"
+        )
+    # The registry stays bounded under the flood: squat traffic allocates
+    # NOTHING, so total indexed state tracks honest traffic + the ring
+    # capacity, orders of magnitude under the squat volume.
+    for label, s in sorted(report.get("proofs", {}).items()):
+        if s.get("registry_size", 0) > 3_000:
+            problems.append(
+                f"node {label}: registry size {s['registry_size']} — "
+                "squat subscriptions appear to allocate state"
+            )
+    # Honest clients still get verified proofs THROUGH the squat flood.
+    totals = _proof_totals(report)
+    if totals["served"] < 4:
+        problems.append(
+            f"only {totals['served']} honest proofs served under squatting"
+        )
+    if totals["verify_failed"]:
+        problems.append(
+            f"{totals['verify_failed']} served proofs failed verification"
+        )
+    return problems
+
+
+_register(
+    Scenario(
+        name="proof_squatter",
+        description="Byzantine nonce-squatting clients flood every node's "
+        "proof port with subscribe-until-commit queries for (client, nonce) "
+        "pairs that were never admitted: each one must be SHED with a retry "
+        "hint and allocate NOTHING (proofs.subs_shed pins the count, the "
+        "registry size stays bounded by honest traffic), while honest "
+        "clients keep receiving verified proofs through the flood.",
+        plan=lambda: FaultPlan(default_link=_LINK),
+        parameters=_agg_cert_params,
+        trusted_crypto=True,
+        duration=12.0,
+        min_commits=0,
+        ingress=_proofs_ingress_load,
+        proofs=True,
+        proof_squat_rate=25.0,
+        expect=_expect_proof_squatter,
+    )
+)
+
+
 def _expect_agg_crash(report: dict, deltas: dict) -> list[str]:
     problems = _expect_counter(deltas, "chaos.crashes")
     problems += _expect_counter(deltas, "chaos.restarts")
@@ -1728,6 +1903,10 @@ MATRIX_SCENARIOS = (
     # the identical seed/size/window and pins the cross-region pivot-hop
     # reduction plus the virtual-clock commit-latency win.
     "wan_election",
+    # ISSUE 19's commit-proof serving cells (§5.5q): the full
+    # submit→commit→proof loop at n=4 and n=64 — every served proof
+    # client-verified, none of the committed admissions unprovable.
+    "ingress_proofs",
 )
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)
@@ -1840,7 +2019,7 @@ def run_matrix_cell(
 
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
-    "telemetry.", "sync.", "reconfig.", "wan.", "agg.", "elect.",
+    "telemetry.", "sync.", "reconfig.", "wan.", "agg.", "elect.", "proofs.",
 )
 
 
@@ -1925,6 +2104,8 @@ def run_scenario(
                 scenario.boundary_crashes() if scenario.boundary_crashes else None
             ),
             trusted_crypto=trusted_crypto or scenario.trusted_crypto,
+            proofs=scenario.proofs,
+            proof_squat_rate=scenario.proof_squat_rate,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

@@ -40,6 +40,7 @@ class Consensus:
         listen_address: Address | None = None,
         overlay_regions: dict[PublicKey, str] | None = None,
         agg_signer=None,
+        proof_registry=None,
     ) -> Core:
         """Boot the consensus plane; returns the Core (its actor task is
         spawned). The committee addresses are this plane's listen ports.
@@ -60,7 +61,10 @@ class Consensus:
         node's aggregate-scheme signing handle (crypto/aggsig.AggSigner);
         required — together with Parameters.aggregate_certs — for the
         node to EMIT aggregate votes/timeouts (§5.5o); inbound aggregate
-        certificates are understood regardless."""
+        certificates are understood regardless. `proof_registry`
+        (proofs/registry.py) receives every committed block with its
+        certifying certificate, feeding the commit-proof serving plane
+        (§5.5q)."""
         # NOTE: boot-time config echo; parsed by the benchmark harness.
         parameters.log(log)
 
@@ -114,6 +118,7 @@ class Consensus:
             verification_service=verification_service,
             overlay_regions=overlay_regions,
             agg_signer=agg_signer,
+            proof_registry=proof_registry,
         )
         spawn(core.run(), name="consensus-core")
         log.info(

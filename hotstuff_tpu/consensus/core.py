@@ -158,6 +158,7 @@ class Core:
         verification_service=None,
         overlay_regions: dict[PublicKey, str] | None = None,
         agg_signer: "aggsig.AggSigner | None" = None,
+        proof_registry=None,
     ) -> None:
         from ..crypto.batch_service import BatchVerificationService
 
@@ -182,6 +183,11 @@ class Core:
         self.core_channel = core_channel
         self.network_tx = network_tx
         self.commit_channel = commit_channel
+        # Commit-proof serving plane (proofs/registry.py): when wired,
+        # every committed block is indexed under its CERTIFYING
+        # certificate — the successor's QC — so clients can be served
+        # O(1) finality proofs (§5.5q).
+        self.proofs = proof_registry
 
         self.round: Round = 1
         self.last_voted_round: Round = 0
@@ -476,7 +482,8 @@ class Core:
         # committed chain just passed WITHOUT applying rode a dead fork —
         # drop it so its boundary stops walling certification.
         await self.epochs.note_commit(self.last_committed_round, store=self.store)
-        for b in reversed(to_commit):
+        for i in range(len(to_commit) - 1, -1, -1):
+            b = to_commit[i]
             d = b.digest()
             _M_COMMITS.inc()
             self._note_cert_stats(b)
@@ -495,6 +502,14 @@ class Core:
             log.info("Committed B%s(%s)", b.round, d)
             for payload_digest in b.payload:
                 log.info("Committed B%s(%s) -> %s", b.round, d, payload_digest)
+            if self.proofs is not None:
+                # The CERTIFYING certificate for to_commit[i] is the
+                # successor block's carried QC (successor.qc.hash == d):
+                # the 2-chain edge a stateless client can verify with
+                # committee keys alone — exactly what the proof plane
+                # serves (§5.5q).
+                cert = (to_commit[i - 1] if i >= 1 else child).qc
+                await self.proofs.note_commit(b, cert)
             await self.commit_channel.put(b)
         # NOTE: parsed by the benchmark LogParser (+ CERTS section).
         log.info(

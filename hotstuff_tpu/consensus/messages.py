@@ -501,6 +501,7 @@ class Block:
         qc: QC | AggQC,
         reconfig: EpochChange | None = None,
     ) -> Digest:
+        # graftlint: allow[wire-schema] proofs/messages.py recomputes this SAME artifact (CommitProof.block_digest) by design — one preimage, two sites
         h = b"HSBLOCK" + author.data + struct.pack("<Q", round_)
         for d in payload:
             h += d.data

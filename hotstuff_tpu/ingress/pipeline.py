@@ -64,9 +64,15 @@ class IngressPipeline:
         service: BatchVerificationService,
         deliver: asyncio.Queue,
         config: IngressConfig | None = None,
+        proof_registry=None,
     ) -> None:
         self.service = service
         self.deliver = deliver
+        # Commit-proof serving plane (proofs/registry.py): when wired,
+        # every VERIFIED-accepted transaction's (client, nonce) → digest
+        # mapping is recorded just before its body enters the mempool
+        # lane — the first link of the submit→commit→proof chain.
+        self.proof_registry = proof_registry
         self.admission = AdmissionController(config)
         self._pending = asyncio.Event()  # set whenever a lane has work
         self._task: asyncio.Task | None = None
@@ -158,6 +164,10 @@ class IngressPipeline:
                 if ok:
                     _M_VERIFIED.inc()
                     accepted += 1
+                    if self.proof_registry is not None:
+                        self.proof_registry.note_tx(
+                            tx.client, tx.nonce, tx.digest(), body=tx.body
+                        )
                     # Bounded sink: blocking here is the backpressure path
                     # (lanes fill behind us, admission sheds with
                     # retry-after) — the one place ingress may wait.

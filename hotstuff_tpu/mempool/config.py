@@ -202,6 +202,11 @@ class MempoolParameters:
     # (the IngressPipeline drain), which is the backpressure chain that
     # ends in admission shedding with retry-after.
     ingress_queue_capacity: int = 2_048
+    # Commit-proof serving plane (hotstuff_tpu/proofs): with ingress
+    # enabled and a ProofRegistry wired by the composition root,
+    # Mempool.run boots a ProofServer on front_port + proofs_port_offset
+    # — the finality-read counterpart of the ingress write port.
+    proofs_port_offset: int = 2_000
     # Byzantine bound on PayloadRequest serving: at most this many payloads
     # are served per request frame (the prefix; the requester's retry loop
     # fetches the rest). Honest requests cover one block's digests —
@@ -230,6 +235,7 @@ class MempoolParameters:
             "ingress_enabled": self.ingress_enabled,
             "ingress_port_offset": self.ingress_port_offset,
             "ingress_queue_capacity": self.ingress_queue_capacity,
+            "proofs_port_offset": self.proofs_port_offset,
         }
 
     @staticmethod
@@ -247,6 +253,7 @@ class MempoolParameters:
             "ingress_enabled",
             "ingress_port_offset",
             "ingress_queue_capacity",
+            "proofs_port_offset",
         ):
             if k in obj:
                 setattr(p, k, obj[k])

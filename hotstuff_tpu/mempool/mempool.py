@@ -34,6 +34,7 @@ class Mempool:
         verification_service=None,
         epoch_manager=None,
         listen_addresses: tuple = None,
+        proof_registry=None,
     ) -> Core:
         """Boot the mempool plane. `consensus_mempool_channel` carries
         Get/Verify/Cleanup requests FROM consensus; `consensus_channel` lets
@@ -104,6 +105,7 @@ class Mempool:
             tx_client,
             core_channel,
             ingress_in=tx_ingress,
+            proof_registry=proof_registry,
         )
         synchronizer = Synchronizer(
             name,
@@ -149,8 +151,23 @@ class Mempool:
 
             IngressServer(
                 ("0.0.0.0", front_addr[1] + parameters.ingress_port_offset),
-                IngressPipeline(core.verification_service, tx_ingress),
+                IngressPipeline(
+                    core.verification_service,
+                    tx_ingress,
+                    proof_registry=proof_registry,
+                ),
             )
+            if proof_registry is not None:
+                # Commit-proof serving plane (§5.5q): the finality
+                # counterpart of the ingress port — clients that
+                # submitted on front+ingress_port_offset fetch their
+                # commit proofs on front+proofs_port_offset.
+                from ..proofs.server import ProofServer, ProofService
+
+                ProofServer(
+                    ("0.0.0.0", front_addr[1] + parameters.proofs_port_offset),
+                    ProofService(proof_registry),
+                )
         spawn(core.run(), name="mempool-core")
         log.info("Mempool of node %s successfully booted on %s", name.short(), mempool_addr)
         return core

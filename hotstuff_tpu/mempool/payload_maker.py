@@ -46,6 +46,7 @@ class PayloadMaker:
         tx_in: asyncio.Queue,
         core_channel: asyncio.Queue,
         ingress_in: asyncio.Queue | None = None,
+        proof_registry=None,
     ) -> None:
         self.name = name
         self.signature_service = signature_service
@@ -54,6 +55,11 @@ class PayloadMaker:
         self.tx_in = tx_in
         self.ingress_in = ingress_in
         self.core_channel = core_channel
+        # Commit-proof serving plane: flushed ingress bodies are paired
+        # back to their admitted tx digests under the payload digest, so
+        # a later commit of that payload resolves (client, nonce) →
+        # proof (proofs/registry.py note_payload).
+        self.proof_registry = proof_registry
         self._make_requests: asyncio.Queue = channel()
         self._buffer: list[Transaction] = []
         self._size = 0
@@ -96,6 +102,8 @@ class PayloadMaker:
         txs, self._buffer = self._buffer[:split], self._buffer[split:]
         self._size -= taken
         digest = Payload.make_digest(self.name, txs)
+        if self.proof_registry is not None and txs:
+            self.proof_registry.note_payload(txs, digest)
         signature = await self.signature_service.request_signature(digest)
         payload = Payload(tuple(txs), self.name, signature)
         object.__setattr__(payload, "_digest", digest)  # seed the cache

@@ -64,6 +64,19 @@ class Node:
         consensus_mempool_channel = channel()
         consensus_core_channel = channel()
 
+        # Commit-proof serving plane (§5.5q): one registry shared by the
+        # ingress pipeline (admitted-tx feed), the payload maker (flush
+        # pairing) and the consensus core (commit feed). The persisted
+        # newest window reloads in the background — queries racing the
+        # load just see PENDING/UNKNOWN until their proofs reappear.
+        self.proof_registry = None
+        if self.parameters.mempool.ingress_enabled:
+            from ..proofs.registry import ProofRegistry
+            from ..utils.actors import spawn
+
+            self.proof_registry = ProofRegistry(store=store)
+            spawn(self.proof_registry.load(), name="proof-registry-load")
+
         Mempool.run(
             name,
             self.committee.mempool,
@@ -77,6 +90,7 @@ class Node:
             # payload gossip fan-out, sync and address resolution cross
             # an epoch boundary at the same activation round (§5.5j).
             epoch_manager=self.epoch_manager,
+            proof_registry=self.proof_registry,
         )
         Consensus.run(
             name,
@@ -89,6 +103,7 @@ class Node:
             core_channel=consensus_core_channel,
             verification_service=verification_service,
             epoch_manager=self.epoch_manager,
+            proof_registry=self.proof_registry,
         )
         log.info("Node %s successfully booted", name.short())
 

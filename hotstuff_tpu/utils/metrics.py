@@ -650,6 +650,19 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("ingress.retry_after_ms", "histogram", SIZE_BUCKETS),
     ("ingress.verify_batch_size", "histogram", SIZE_BUCKETS),
     ("ingress.latency_s", "histogram", None),
+    # proofs/ — commit-proof serving plane (registry + service)
+    ("proofs.indexed", "counter", None),
+    ("proofs.resolved", "counter", None),
+    ("proofs.evicted", "counter", None),
+    ("proofs.cert_mismatch", "counter", None),
+    ("proofs.queries", "counter", None),
+    ("proofs.served", "counter", None),
+    ("proofs.unknown", "counter", None),
+    ("proofs.subs_shed", "counter", None),
+    ("proofs.malformed", "counter", None),
+    ("proofs.registry_size", "gauge", None),
+    ("proofs.serve_s", "histogram", None),
+    ("proofs.proof_bytes", "histogram", SIZE_BUCKETS),
     # network/net.py
     ("net.bytes_sent", "counter", None),
     ("net.frames_sent", "counter", None),

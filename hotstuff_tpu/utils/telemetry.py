@@ -158,6 +158,18 @@ def default_slos() -> tuple[SLOSpec, ...]:
             objective=0.99,
         )
     )
+    # Commit-proof serving (§5.5q): time from a proof query arriving to
+    # the proof in the reply — for subscribe-until-commit queries this
+    # spans the residual commit wait, so the target is the sub-second
+    # finality-read contract, not a local lookup bound.
+    slos.append(
+        SLOSpec(
+            name="proofs.serve",
+            metric="proofs.serve_s",
+            threshold_s=1.0,
+            objective=0.99,
+        )
+    )
     return tuple(slos)
 
 
@@ -171,6 +183,7 @@ _DEFAULT_PREFIXES = (
     "ingress.",
     "mempool.",
     "net.",
+    "proofs.",
     "reconfig.",
     "scheduler.",
     "telemetry.",

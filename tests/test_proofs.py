@@ -1,0 +1,397 @@
+"""Commit-proof serving plane tests (§5.5q): the CommitProof codec
+(round-trip, legacy version-0 interop, version-byte bounds), stateless
+verification against exact pysigner entry-list QCs AND trusted-agg
+AggQCs, tampered-proof rejection, the registry's ring eviction +
+persistence reload, the bounded subscription table, and the end-to-end
+chaos pin (every admitted-and-committed transaction is provable).
+
+Dependency-free (no `cryptography`, no real sockets): signing rides
+hotstuff_tpu/crypto/pysigner.py, certificate verification runs under the
+PurePythonBackend, and scenarios run on the VirtualTimeLoop."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import pytest
+
+from hotstuff_tpu.chaos.trusted_crypto import TrustedAggScheme
+from hotstuff_tpu.consensus import Block, Committee, QC
+from hotstuff_tpu.consensus.errors import InvalidSignatureError
+from hotstuff_tpu.consensus.messages import AggQC, _vote_digest
+from hotstuff_tpu.crypto import Digest, PublicKey, Signature, aggsig, pysigner
+from hotstuff_tpu.crypto.backend import set_backend
+from hotstuff_tpu.crypto.pysigner import PurePythonBackend
+from hotstuff_tpu.proofs import (
+    MODE_QUERY,
+    MODE_SUBSCRIBE,
+    PROOF_OK,
+    PROOF_PENDING,
+    PROOF_SHED,
+    PROOF_UNKNOWN,
+    CommitProof,
+    ProofQuery,
+    ProofRegistry,
+    ProofReply,
+    ProofService,
+    ProofVerificationError,
+    decode_proof_message,
+    encode_proof_message,
+)
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.serde import Reader, SerdeError, Writer
+
+
+def _fleet(n: int = 4, tag: bytes = b"proof", epoch: int = 1):
+    """n (identity PublicKey, seed) pairs in sorted-key order plus their
+    Committee — the test_aggsig.py key ceremony."""
+    pairs = [
+        pysigner.keypair_from_seed(tag + bytes(31 - len(tag)) + bytes([i]))
+        for i in range(n)
+    ]
+    pairs.sort(key=lambda kp: kp[0])
+    keys = [(PublicKey(pk), seed) for pk, seed in pairs]
+    cmt = Committee.new(
+        [(pk, 1, ("127.0.0.1", 7100 + i)) for i, (pk, _) in enumerate(keys)],
+        epoch=epoch,
+    )
+    return keys, cmt
+
+
+def _proof_with_qc(keys, round_=3, payload_n=1, reconfig_digest=None):
+    """A CommitProof whose cert is a 3-of-4 pysigner-signed entry-list QC
+    over the proof's OWN recomputed block digest — exactly what an honest
+    node serves, minus the Block object it never needs to ship."""
+    author = keys[round_ % len(keys)][0]
+    payload = tuple(Digest.of(f"tx-{i}".encode()) for i in range(payload_n))
+    skeleton = CommitProof(
+        author, round_, payload, Digest.of(b"parent"), round_ - 1,
+        QC.genesis(), reconfig_digest,
+    )
+    digest = skeleton.block_digest()
+    msg = _vote_digest(digest, round_).data
+    votes = tuple(
+        (pk, Signature(pysigner.sign(seed, msg))) for pk, seed in keys[:3]
+    )
+    return dataclasses.replace(skeleton, cert=QC(digest, round_, votes))
+
+
+# --- codec: round-trip, tagged envelope, legacy interop ----------------------
+
+
+def test_proof_wire_roundtrip_and_envelope():
+    keys, _ = _fleet()
+    for proof in (
+        _proof_with_qc(keys),
+        _proof_with_qc(keys, payload_n=3),
+        _proof_with_qc(keys, reconfig_digest=Digest.of(b"epoch-change")),
+    ):
+        w = Writer()
+        proof.encode(w)
+        assert CommitProof.decode(Reader(w.bytes())) == proof
+        assert proof.encoded_size() == len(w.bytes())
+    # tagged envelope: query and reply round-trip through one codec
+    query = ProofQuery(keys[0][0], 42, MODE_SUBSCRIBE)
+    assert decode_proof_message(encode_proof_message(query)) == query
+    proof = _proof_with_qc(keys)
+    for reply in (
+        ProofReply(42, PROOF_OK, 0, proof),
+        ProofReply(7, PROOF_SHED, 250),
+    ):
+        assert decode_proof_message(encode_proof_message(reply)) == reply
+    # trailing garbage is a malformed frame, not a silent accept
+    with pytest.raises(SerdeError):
+        decode_proof_message(encode_proof_message(query) + b"\x00")
+
+
+def test_legacy_v0_interop_and_version_bounds():
+    """Version-0 proofs (pre-reconfig: no epoch field, bare entry-list
+    QC) still decode; the v0 encoder refuses shapes v0 cannot carry; an
+    unknown future version byte is rejected, never misparsed."""
+    keys, cmt = _fleet()
+    proof = _proof_with_qc(keys)
+    w = Writer()
+    proof.encode(w, version=0)
+    decoded = CommitProof.decode(Reader(w.bytes()))
+    assert decoded == proof and decoded.reconfig_digest is None
+    # v0 cannot carry an epoch change…
+    with pytest.raises(ValueError):
+        _proof_with_qc(keys, reconfig_digest=Digest.of(b"e")).encode(
+            Writer(), version=0
+        )
+    # …nor an aggregate certificate
+    agg = dataclasses.replace(
+        proof, cert=AggQC(proof.cert.hash, proof.round, 0b0111, b"\x00" * 48)
+    )
+    with pytest.raises(ValueError):
+        agg.encode(Writer(), version=0)
+    with pytest.raises(ValueError):
+        proof.encode(Writer(), version=9)
+    blob = bytearray(encode_proof_message(ProofReply(1, PROOF_OK, 0, proof)))
+    # reply layout: tag(1) + nonce(8) + status(1) + retry(4) + present(1),
+    # then the proof's leading version byte
+    blob[15] = 9
+    with pytest.raises(SerdeError):
+        decode_proof_message(bytes(blob))
+
+
+# --- stateless verification --------------------------------------------------
+
+
+def test_stateless_verification_exact_pysigner():
+    """A client holding nothing but the committee public keys verifies
+    the proof end to end: digest recomputation, certificate binding,
+    payload membership, and real RFC 8032 batch verification."""
+    keys, cmt = _fleet()
+    proof = _proof_with_qc(keys, payload_n=2)
+    prev = set_backend(PurePythonBackend())
+    try:
+        proof.verify(cmt)
+        proof.verify(cmt, payload_digest=proof.payload[1])
+        with pytest.raises(ProofVerificationError):
+            proof.verify(cmt, payload_digest=Digest.of(b"not-in-the-block"))
+    finally:
+        set_backend(prev)
+
+
+def test_stateless_verification_trusted_agg_and_size():
+    """The same proof under the trusted-agg scheme: an AggQC certificate
+    verifies through the scheme seam, and the whole single-payload proof
+    stays within the O(1) ~300 B envelope at n=4 (the chaos scenarios
+    pin the same bound at n=64)."""
+    keys, cmt = _fleet()
+    scheme = TrustedAggScheme()
+    prev_scheme = aggsig.install_agg_scheme(scheme)
+    prev_reg = aggsig.install_agg_registry(
+        {pk.data: scheme.keypair_from_seed(seed)[0] for pk, seed in keys}
+    )
+    try:
+        base = _proof_with_qc(keys)
+        digest = base.block_digest()
+        msg = _vote_digest(digest, base.round).data
+        bitmap = aggsig.bitmap_of(
+            [pk for pk, _ in keys[:3]], cmt.sorted_keys()
+        )
+        cert = AggQC(
+            digest, base.round, bitmap,
+            scheme.aggregate([scheme.sign(s, msg) for _, s in keys[:3]]),
+        )
+        proof = dataclasses.replace(base, cert=cert)
+        proof.verify(cmt, payload_digest=proof.payload[0])
+        assert proof.encoded_size() <= 311  # PROOF_BYTES_CORE + ceil(4/8)
+    finally:
+        aggsig.install_agg_scheme(prev_scheme)
+        aggsig.install_agg_registry(prev_reg)
+
+
+def test_tampered_proof_rejected():
+    """Any field edit breaks the digest binding BEFORE certificate
+    crypto; a flipped signature bit survives binding but fails batch
+    verification."""
+    keys, cmt = _fleet()
+    proof = _proof_with_qc(keys)
+    prev = set_backend(PurePythonBackend())
+    try:
+        for tampered in (
+            dataclasses.replace(proof, round=proof.round + 1),
+            dataclasses.replace(proof, author=keys[0][0]
+                                if proof.author != keys[0][0] else keys[1][0]),
+            dataclasses.replace(proof, payload=(Digest.of(b"swapped"),)),
+            dataclasses.replace(proof, parent_round=proof.parent_round + 1),
+            dataclasses.replace(
+                proof, reconfig_digest=Digest.of(b"grafted-epoch")
+            ),
+        ):
+            with pytest.raises(ProofVerificationError):
+                tampered.verify(cmt)
+        # certificate round disagreeing with the block round: binding
+        cert = proof.cert
+        with pytest.raises(ProofVerificationError):
+            dataclasses.replace(
+                proof,
+                round=proof.round,
+                cert=QC(cert.hash, cert.round + 1, cert.votes),
+            ).verify(cmt)
+        # bit-flip one vote signature: binding passes, crypto fails
+        (pk0, sig0), *rest = cert.votes
+        bad = Signature(sig0.data[:-1] + bytes([sig0.data[-1] ^ 1]))
+        forged = dataclasses.replace(
+            proof, cert=QC(cert.hash, cert.round, ((pk0, bad), *rest))
+        )
+        with pytest.raises(InvalidSignatureError):
+            forged.verify(cmt)
+    finally:
+        set_backend(prev)
+
+
+# --- registry: ring eviction, persistence, bounded subscriptions -------------
+
+
+def _committed_chain(keys, rounds):
+    """(block, certifying QC) pairs for rounds 1..rounds, chained like
+    Core._commit hands them over. Votes are irrelevant to the registry
+    (it checks binding, not crypto) so the certs carry none."""
+    author = keys[0][0]
+    blocks = []
+    qc = QC.genesis()
+    for r in range(1, rounds + 1):
+        payload = (Digest.of(f"blk-{r}".encode()),)
+        digest = Block.make_digest(author, r, list(payload), qc)
+        block = Block(qc, None, author, r, payload, Signature(bytes(64)))
+        assert block.digest() == digest
+        cert = QC(digest, r, ())
+        blocks.append((block, cert))
+        qc = cert
+    return blocks
+
+
+def test_registry_ring_eviction_and_persistence_reload(run_async, tmp_path):
+    path = str(tmp_path / "proof-store")
+    keys, _ = _fleet()
+
+    async def write_phase():
+        store = Store(path)
+        reg = ProofRegistry(store=store, capacity=2, persist_window=2)
+        chain = _committed_chain(keys, 3)
+        for block, cert in chain:
+            await reg.note_commit(block, cert)
+        # oldest block's payload evicted from the bounded ring
+        assert reg.proof_for_payload(chain[0][0].payload[0]) is None
+        assert reg.stats["evicted"] == 1
+        for block, cert in chain[1:]:
+            got = reg.proof_for_payload(block.payload[0])
+            assert got is not None and got.cert == cert
+        # a certificate that does not certify the block is never indexed
+        rogue_block, _ = _committed_chain(keys, 1)[0]
+        await reg.note_commit(
+            rogue_block, QC(Digest.of(b"wrong"), rogue_block.round, ())
+        )
+        assert reg.stats["mismatch"] == 1
+        assert reg.proof_for_payload(rogue_block.payload[0]) is None
+        store.close()
+        return chain
+
+    chain = run_async(write_phase())
+
+    async def reload_phase():
+        store = Store(path)
+        reg = ProofRegistry(store=store)
+        assert await reg.load() == 2  # the persisted newest window
+        for block, cert in chain[1:]:
+            got = reg.proof_for_payload(block.payload[0])
+            assert got is not None and got.cert == cert
+        assert reg.proof_for_payload(chain[0][0].payload[0]) is None
+        store.close()
+
+    run_async(reload_phase())
+
+
+def test_registry_waiters_bounded_and_commit_wakes_them(run_async):
+    keys, _ = _fleet()
+    client = keys[0][0]
+
+    async def body():
+        reg = ProofRegistry(max_waiters=2)
+        # chaos identity path: each tx digest rides the block AS a
+        # payload digest (one digest per admitted nonce)
+        payload = tuple(Digest.of(f"tx-{n}".encode()) for n in range(3))
+        author = keys[0][0]
+        digest = Block.make_digest(author, 1, list(payload), QC.genesis())
+        block = Block(
+            QC.genesis(), None, author, 1, payload, Signature(bytes(64))
+        )
+        cert = QC(digest, 1, ())
+        for nonce in (0, 1, 2):
+            reg.note_tx(client, nonce, payload[nonce])
+        futs = [reg.add_waiter(client, n) for n in (0, 1)]
+        assert all(f is not None for f in futs)
+        assert reg.add_waiter(client, 2) is None  # table full: shed
+        assert reg.waiters() == 2
+        await reg.note_commit(block, cert)
+        for fut in futs:
+            assert fut.done() and fut.result().cert == cert
+        assert reg.waiters() == 0
+        proof, known = reg.proof_for_client(client, 1)
+        assert known and proof is not None and proof.cert == cert
+
+    run_async(body())
+
+
+def test_service_reply_states(run_async):
+    """The serving contract end to end against one in-process service:
+    UNKNOWN for never-admitted keys, PENDING (with a retry hint) once
+    admitted, SHED for unknown-nonce subscribes, OK with the proof after
+    the commit lands."""
+    keys, _ = _fleet()
+    client = keys[0][0]
+
+    async def body():
+        reg = ProofRegistry()
+        svc = ProofService(reg)
+        (block, cert), = _committed_chain(keys, 1)
+        txd = block.payload[0]
+        reply = await svc.handle(ProofQuery(client, 0, MODE_QUERY), 0.0)
+        assert reply.status == PROOF_UNKNOWN
+        # an unknown-nonce SUBSCRIBE is shed (zero allocation), hinted
+        reply = await svc.handle(ProofQuery(client, 0, MODE_SUBSCRIBE), 0.0)
+        assert reply.status == PROOF_SHED and reply.retry_after_ms > 0
+        reg.note_tx(client, 0, txd)
+        reply = await svc.handle(ProofQuery(client, 0, MODE_QUERY), 0.0)
+        assert reply.status == PROOF_PENDING and reply.retry_after_ms > 0
+        await reg.note_commit(block, cert)
+        reply = await svc.handle(ProofQuery(client, 0, MODE_QUERY), 1.0)
+        assert reply.status == PROOF_OK
+        assert reply.proof is not None and reply.proof.cert == cert
+        assert svc.stats["served"] == 1
+        assert svc.stats["worst_proof_bytes"] == reply.proof.encoded_size()
+
+    run_async(body())
+
+
+# --- the end-to-end chaos pin (tier-1 acceptance) ----------------------------
+
+
+def test_ingress_proofs_scenario_closes_the_loop():
+    """The acceptance row: under link faults, every transaction the
+    ingress plane ADMITS and consensus COMMITS is eventually provable —
+    each tracked client holds a wire-round-tripped, fully verified
+    CommitProof, none is left unproved, and the worst served proof stays
+    inside the O(1) byte envelope."""
+    from hotstuff_tpu.chaos import run_scenario
+    from hotstuff_tpu.chaos.scenarios import _proof_bytes_bound
+
+    report = run_scenario("ingress_proofs", seed=11)
+    assert report["ok"], report
+    assert report.get("expectation_failures", []) == []
+    assert report["safety_violations"] == []
+    summaries = report["proofs"].values()
+    assert summaries
+    for s in summaries:
+        assert s["tracked"] > 0
+        assert s["served"] == s["verified_ok"] > 0
+        assert s["verify_failed"] == 0
+        assert s["unproved_committed"] == 0
+        assert 0 < s["proof_bytes_max"] <= _proof_bytes_bound(4)
+        assert s["latency_ms"]["p99"] >= s["latency_ms"]["p50"] > 0
+    assert report["metrics"]["proofs.served"] >= 4
+    assert report["metrics"].get("proofs.cert_mismatch", 0) == 0
+
+
+def test_proof_squatter_sheds_without_allocating():
+    """The Byzantine row: a nonce-squatting flood of never-admitted
+    subscriptions is shed to the last query (bounded subscription
+    table, zero waiter allocation) while honest clients still get
+    their proofs and every registry stays bounded."""
+    from hotstuff_tpu.chaos import run_scenario
+
+    report = run_scenario("proof_squatter", seed=11)
+    assert report["ok"], report
+    assert report.get("expectation_failures", []) == []
+    squat = report["proof_squat"].values()
+    assert squat
+    for s in squat:
+        assert s["sent"] > 0 and s["shed"] == s["sent"]
+    assert report["metrics"]["proofs.subs_shed"] >= 200
+    for s in report["proofs"].values():
+        assert s["served"] == s["verified_ok"] > 0
+        assert s["registry_size"] <= 3_000

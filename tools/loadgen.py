@@ -10,6 +10,15 @@
     # loop — no node, no jax, no OpenSSL wheel, deterministic per --seed:
     python tools/loadgen.py --selftest --curve flash --duration 20
 
+    # close the submit→commit→proof loop: --proofs subscribes for a commit
+    # proof on every ACCEPTED tx and reports submit→proof-in-hand latency
+    # percentiles (selftest certifies admitted digests with a synthetic
+    # 4-key committee and verifies proofs STATELESSLY; tcp queries the
+    # node's proof port). --procs N shards the curve across N processes
+    # and merges the summaries (count-weighted percentile pooling):
+    python tools/loadgen.py --selftest --proofs --rate 50 --duration 10
+    python tools/loadgen.py --selftest --procs 4 --rate 400 --duration 10
+
 Traffic is OPEN loop (hotstuff_tpu/ingress/loadgen.py): arrivals follow
 the curve regardless of responses, which is what makes admission control
 observable — a closed-loop client slows itself down and can never
@@ -34,6 +43,8 @@ import json
 import logging
 import os
 import sys
+
+from collections import deque
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -87,6 +98,176 @@ async def _drive(submit, args, rng) -> dict:
     return gen.log_summary()
 
 
+class _ProofTracker:
+    """--proofs client plane: wraps submit so every ACCEPTED transaction
+    also subscribes for its commit proof, then checks what a client CAN
+    check — with `committee` (selftest) the full stateless verification
+    against the committee keys; without it (TCP: the generator holds no
+    committee file) the digest-binding subset (certificate hash ==
+    recomputed block digest, tx digest in the committed payload set).
+    Certificate crypto is deduped per block: proofs from one block share
+    one certificate (~20 ms/vote pure-python), bindings are per-proof."""
+
+    def __init__(self, subscribe, committee=None) -> None:
+        self._subscribe = subscribe  # async ProofQuery -> ProofReply
+        self.committee = committee
+        self.stats = {
+            "tracked": 0, "served": 0, "verified_ok": 0,
+            "verify_failed": 0, "retries": 0, "errors": 0,
+            "proof_bytes_max": 0,
+        }
+        self.latencies_s: list[float] = []
+        self._verified_certs: set[tuple[bytes, int]] = set()
+
+    def track(self, tx) -> None:
+        """Start one subscribe-until-commit client for an ACCEPTED tx."""
+        from hotstuff_tpu.utils.actors import spawn
+
+        self.stats["tracked"] += 1
+        spawn(
+            self._track(tx.client, tx.nonce, tx.digest()),
+            name=f"loadgen-proof-{self.stats['tracked']}",
+        )
+
+    async def _track(self, client, nonce, digest) -> None:
+        from hotstuff_tpu.proofs import MODE_SUBSCRIBE, PROOF_OK, ProofQuery
+
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        while True:
+            try:
+                reply = await self._subscribe(
+                    ProofQuery(client, nonce, MODE_SUBSCRIBE)
+                )
+            except (ConnectionError, OSError):
+                self.stats["errors"] += 1
+                return
+            if reply.status == PROOF_OK:
+                break
+            self.stats["retries"] += 1
+            await asyncio.sleep(max(reply.retry_after_ms, 50) / 1000.0)
+        proof = reply.proof
+        self.stats["served"] += 1
+        self.latencies_s.append(loop.time() - t0)
+        self.stats["proof_bytes_max"] = max(
+            self.stats["proof_bytes_max"], proof.encoded_size()
+        )
+        if self._verify(proof, digest):
+            self.stats["verified_ok"] += 1
+        else:
+            self.stats["verify_failed"] += 1
+
+    def _verify(self, proof, digest) -> bool:
+        try:
+            if proof.cert.hash != proof.block_digest():
+                return False
+            if proof.cert.round != proof.round or digest not in proof.payload:
+                return False
+            if self.committee is not None:
+                key = (proof.cert.hash.data, proof.cert.round)
+                if key not in self._verified_certs:
+                    proof.cert.verify(self.committee)
+                    self._verified_certs.add(key)
+            return True
+        except Exception:
+            return False
+
+    async def settle(self, grace_s: float = 10.0) -> None:
+        """Give in-flight subscriptions past the load window a bounded
+        chance to resolve (the commit tail is still draining)."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + grace_s
+        while (
+            self.stats["served"] + self.stats["errors"]
+            < self.stats["tracked"]
+            and loop.time() < deadline
+        ):
+            await asyncio.sleep(0.2)
+
+    def summary(self) -> dict:
+        from hotstuff_tpu.utils.metrics import percentile
+
+        lat_ms = [s * 1000.0 for s in self.latencies_s]
+        out = dict(self.stats)
+        out["pending"] = self.stats["tracked"] - self.stats["served"]
+        out["verified"] = "stateless" if self.committee else "binding-only"
+        out["latency_ms"] = {
+            "count": len(lat_ms),
+            "p50": round(percentile(lat_ms, 0.50), 3),
+            "p99": round(percentile(lat_ms, 0.99), 3),
+            "max": round(max(lat_ms), 3) if lat_ms else 0.0,
+        }
+        return out
+
+
+class _SelftestCommitter:
+    """--selftest --proofs commit plane: a seeded 4-key pysigner
+    committee whose synthetic leader drains admitted tx digests into REAL
+    signed Blocks certified by REAL 3-of-4 QCs every `interval`, feeding
+    ProofRegistry.note_commit — so the served proofs verify under the
+    exact stateless check a production client runs, with no consensus
+    stack in the loop."""
+
+    QUORUM = 3  # 2f+1 of 4
+
+    def __init__(self, registry, rng, interval: float = 0.25) -> None:
+        from hotstuff_tpu.consensus.config import Committee
+        from hotstuff_tpu.consensus.messages import QC
+        from hotstuff_tpu.crypto import pysigner
+        from hotstuff_tpu.crypto.primitives import PublicKey
+
+        self.registry = registry
+        self.interval = interval
+        pairs = sorted(
+            pysigner.keypair_from_seed(rng.randbytes(32)) for _ in range(4)
+        )
+        self._keys = [(PublicKey(pk), seed) for pk, seed in pairs]
+        self.committee = Committee.new(
+            [(pk, 1, ("127.0.0.1", 0)) for pk, _ in self._keys]
+        )
+        self.pending: deque = deque(maxlen=65_536)
+        self._qc = QC.genesis()
+        self._round = 0
+        self.blocks = 0
+
+    async def run(self) -> None:
+        # Dependency-free signing via pysigner (not SecretKey.to_crypto:
+        # the selftest contract is "no OpenSSL wheel required").
+        from hotstuff_tpu.consensus.messages import QC, Block
+        from hotstuff_tpu.crypto import pysigner
+        from hotstuff_tpu.crypto.primitives import Signature
+
+        while True:
+            await asyncio.sleep(self.interval)
+            if not self.pending:
+                continue
+            payload = tuple(
+                self.pending.popleft()
+                for _ in range(min(len(self.pending), 8))
+            )
+            self._round += 1
+            author_pk, author_seed = self._keys[self._round % len(self._keys)]
+            digest = Block.make_digest(
+                author_pk, self._round, list(payload), self._qc
+            )
+            block = Block(
+                self._qc, None, author_pk, self._round, payload,
+                Signature(pysigner.sign(author_seed, digest.data)),
+            )
+            vote_digest = QC(block.digest(), self._round, ()).signed_digest()
+            qc = QC(
+                block.digest(),
+                self._round,
+                tuple(
+                    (pk, Signature(pysigner.sign(seed, vote_digest.data)))
+                    for pk, seed in self._keys[: self.QUORUM]
+                ),
+            )
+            await self.registry.note_commit(block, qc)
+            self._qc = qc
+            self.blocks += 1
+
+
 def _run_selftest(args) -> dict:
     import random
 
@@ -95,6 +276,12 @@ def _run_selftest(args) -> dict:
     from hotstuff_tpu.crypto.pysigner import PurePythonBackend
 
     async def body() -> dict:
+        # Signature.verify_batch (cert verification in the proof tracker)
+        # dispatches through the process-global backend, which defaults to
+        # the OpenSSL CpuBackend -- not available on dependency-free hosts.
+        from hotstuff_tpu.crypto.backend import set_backend
+
+        prev_backend = set_backend(PurePythonBackend())
         service = BatchVerificationService(
             backend=PurePythonBackend(), inline=True
         )
@@ -112,11 +299,54 @@ def _run_selftest(args) -> dict:
         pipeline = IngressPipeline(
             service, sink, _selftest_config(args.capacity)
         )
+        submit = pipeline.submit
+        tracker = committer_task = None
+        if args.proofs:
+            from hotstuff_tpu.proofs import ProofRegistry, ProofService
+
+            registry = ProofRegistry()
+            proof_service = ProofService(registry)
+            committer = _SelftestCommitter(
+                registry,
+                random.Random(args.seed ^ 0x5051),  # own stream: traffic
+                # replay must not shift when --proofs toggles
+                interval=args.commit_interval,
+            )
+            loop = asyncio.get_running_loop()
+            tracker = _ProofTracker(
+                lambda q: proof_service.handle(q, loop.time()),
+                committee=committer.committee,
+            )
+            committer_task = spawn(committer.run(), name="loadgen-committer")
+            from hotstuff_tpu.ingress import messages as ingress_messages
+
+            base_submit = submit
+
+            async def submit_with_proofs(tx):
+                resp = await base_submit(tx)
+                if resp.status == ingress_messages.ACCEPTED:
+                    # The admitted digest rides the next synthetic block —
+                    # the payload-maker pairing the real node does — and a
+                    # proof client subscribes for it.
+                    registry.note_tx(tx.client, tx.nonce, tx.digest())
+                    committer.pending.append(tx.digest())
+                    tracker.track(tx)
+                return resp
+
+            submit = submit_with_proofs
         try:
-            summary = await _drive(pipeline.submit, args, random.Random(args.seed))
+            summary = await _drive(submit, args, random.Random(args.seed))
+            if tracker is not None:
+                await tracker.settle()
         finally:
             drainer.cancel()
+            if committer_task is not None:
+                committer_task.cancel()
+            set_backend(prev_backend)
         summary["mode"] = "selftest"
+        if tracker is not None:
+            summary["proofs"] = tracker.summary()
+            summary["proofs"]["blocks"] = committer.blocks
         return summary
 
     return vtime.run(body(), timeout=args.duration * 20 + 600, wall_timeout=600)
@@ -134,15 +364,177 @@ def _run_tcp(args) -> dict:
     async def body() -> dict:
         client = IngressClient()
         await client.connect((host, int(port)))
+        proof_client = tracker = None
+        submit = client.submit
+        if args.proofs:
+            from hotstuff_tpu.proofs import ProofClient
+
+            # The proof port rides the same host as ingress, offset by
+            # (proofs_port_offset - ingress_port_offset); --proofs-target
+            # overrides when the node was configured differently.
+            if args.proofs_target:
+                phost, _, pport = args.proofs_target.rpartition(":")
+            else:
+                phost, pport = host, str(int(port) + 1_000)
+            proof_client = ProofClient()
+            await proof_client.connect((phost, int(pport)))
+            tracker = _ProofTracker(proof_client.query)
+            base_submit = submit
+
+            from hotstuff_tpu.ingress import messages as ingress_messages
+
+            async def submit_with_proofs(tx):
+                resp = await base_submit(tx)
+                if resp.status == ingress_messages.ACCEPTED:
+                    tracker.track(tx)
+                return resp
+
+            submit = submit_with_proofs
         try:
-            summary = await _drive(client.submit, args, random.Random(args.seed))
+            summary = await _drive(submit, args, random.Random(args.seed))
+            if tracker is not None:
+                await tracker.settle()
         finally:
             client.close()
+            if proof_client is not None:
+                proof_client.close()
         summary["mode"] = "tcp"
         summary["target"] = args.target
+        if tracker is not None:
+            summary["proofs"] = tracker.summary()
         return summary
 
     return asyncio.run(body())
+
+
+def _shard_argv(args, index: int, procs: int, json_path: str) -> list[str]:
+    """Per-shard CLI: the curve is split 1/procs per process (open-loop
+    rates add), seeds are disjoint, summaries land in per-shard files."""
+    argv = ["--selftest"] if args.selftest else ["--target", args.target]
+    argv += [
+        "--curve", args.curve,
+        "--rate", str(args.rate / procs),
+        "--peak", str(args.peak / procs if args.peak else 0.0),
+        "--spike-start", str(args.spike_start),
+        "--spike-end", str(args.spike_end),
+        "--period", str(args.period),
+        "--duration", str(args.duration),
+        "--clients", str(max(1, args.clients // procs)),
+        "--tx-bytes", str(args.tx_bytes),
+        "--seed", str(args.seed + index),
+        "--capacity", str(args.capacity / procs),
+        "--commit-interval", str(args.commit_interval),
+        "--json-out", json_path,
+    ]
+    if args.proofs:
+        argv.append("--proofs")
+    if args.proofs_target:
+        argv += ["--proofs-target", args.proofs_target]
+    if args.verbose:
+        argv.append("-v")
+    return argv
+
+
+def _merge_shards(summaries: list[dict], procs: int) -> dict:
+    """Pool per-shard summaries into one fleet view: counts add, latency
+    percentiles merge through telemetry.merge_lane_summaries (the same
+    count-weighted pooling the chaos fleet rollup uses)."""
+    from hotstuff_tpu.utils.telemetry import merge_lane_summaries
+
+    counts = (
+        "offered", "responded", "accepted", "shed", "retry_hints",
+        "bad_signature", "replay", "malformed", "errors", "unresolved",
+    )
+    merged: dict = {"mode": "sharded", "procs": procs, "shards": summaries}
+    for k in counts:
+        merged[k] = sum(s.get(k, 0) for s in summaries)
+    merged["shed_rate"] = (
+        merged["shed"] / merged["responded"] if merged["responded"] else 0.0
+    )
+    lanes = {
+        f"shard-{i}": {
+            "client": {
+                "count": s.get("responded", 0),
+                "p50_ms": s.get("latency_ms", {}).get("p50", 0.0),
+                "p99_ms": s.get("latency_ms", {}).get("p99", 0.0),
+                "max_ms": s.get("latency_ms", {}).get("max", 0.0),
+            }
+        }
+        for i, s in enumerate(summaries)
+    }
+    pooled = merge_lane_summaries(lanes).get("client")
+    if pooled:
+        merged["latency_ms"] = {
+            "p50": pooled["p50_ms"], "p99": pooled["p99_ms"],
+            "max": pooled["max_ms"],
+        }
+    if any("proofs" in s for s in summaries):
+        pcounts = (
+            "tracked", "served", "verified_ok", "verify_failed",
+            "retries", "errors", "pending",
+        )
+        proofs: dict = {
+            k: sum(s.get("proofs", {}).get(k, 0) for s in summaries)
+            for k in pcounts
+        }
+        proofs["proof_bytes_max"] = max(
+            s.get("proofs", {}).get("proof_bytes_max", 0) for s in summaries
+        )
+        plat = merge_lane_summaries(
+            {
+                f"shard-{i}": {
+                    "proof": {
+                        "count": s["proofs"]["latency_ms"].get("count", 0),
+                        "p50_ms": s["proofs"]["latency_ms"].get("p50", 0.0),
+                        "p99_ms": s["proofs"]["latency_ms"].get("p99", 0.0),
+                        "max_ms": s["proofs"]["latency_ms"].get("max", 0.0),
+                    }
+                }
+                for i, s in enumerate(summaries)
+                if "proofs" in s
+            }
+        ).get("proof")
+        if plat:
+            proofs["latency_ms"] = {
+                "count": plat["count"], "p50": plat["p50_ms"],
+                "p99": plat["p99_ms"], "max": plat["max_ms"],
+            }
+        merged["proofs"] = proofs
+    return merged
+
+
+def _run_procs(args) -> tuple[dict, int]:
+    """--procs N: N loadgen subprocesses with split rates and disjoint
+    seeds, merged into one summary. One generator process tops out around
+    a few thousand signed tx/s; sharding is how the tool offers more."""
+    import subprocess
+    import tempfile
+
+    procs: list[subprocess.Popen] = []
+    paths: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="loadgen-shards-") as tmp:
+        for i in range(args.procs):
+            path = os.path.join(tmp, f"shard-{i}.json")
+            paths.append(path)
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__)]
+                    + _shard_argv(args, i, args.procs, path),
+                    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+                )
+            )
+        rcs = [p.wait() for p in procs]
+        summaries = []
+        for path in paths:
+            try:
+                with open(path) as f:
+                    summaries.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                pass
+    merged = _merge_shards(summaries, args.procs)
+    merged["shard_rcs"] = rcs
+    rc = 2 if (any(rcs) or len(summaries) != args.procs) else 0
+    return merged, rc
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -179,8 +571,37 @@ def main(argv: list[str] | None = None) -> int:
         help="selftest drain capacity (tx/s) the curve runs against",
     )
     ap.add_argument("--json-out", default=None, help="also write the summary here")
+    ap.add_argument(
+        "--proofs",
+        action="store_true",
+        help="subscribe for commit proofs on every ACCEPTED tx and report "
+        "submit→proof-in-hand latency percentiles (selftest: a synthetic "
+        "4-key committer certifies admitted digests with real QCs and "
+        "proofs verify statelessly; tcp: queries the node's proof port)",
+    )
+    ap.add_argument(
+        "--proofs-target",
+        default=None,
+        help="proof port host:port (default: ingress port + 1000, the "
+        "proofs_port_offset - ingress_port_offset gap)",
+    )
+    ap.add_argument(
+        "--commit-interval",
+        type=float,
+        default=0.25,
+        help="selftest --proofs: synthetic commit tick (virtual seconds)",
+    )
+    ap.add_argument(
+        "--procs",
+        type=int,
+        default=1,
+        help="shard the curve across N loadgen subprocesses (rates split "
+        "evenly, seeds disjoint) and merge the summaries",
+    )
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.procs < 1:
+        ap.error("--procs must be >= 1")
 
     if args.curve == "flash" and args.spike_end <= args.spike_start:
         # A flash curve without a window is just `sustained`; default the
@@ -193,13 +614,17 @@ def main(argv: list[str] | None = None) -> int:
         format="[%(asctime)s %(levelname)s %(name)s] %(message)s",
     )
 
-    summary = _run_selftest(args) if args.selftest else _run_tcp(args)
+    if args.procs > 1:
+        summary, rc = _run_procs(args)
+    else:
+        summary = _run_selftest(args) if args.selftest else _run_tcp(args)
+        rc = 2 if summary.get("errors") or summary.get("unresolved") else 0
     line = json.dumps(summary, sort_keys=True)
     print(line)
     if args.json_out:
         with open(args.json_out, "w") as f:
             f.write(line + "\n")
-    return 2 if summary.get("errors") or summary.get("unresolved") else 0
+    return rc
 
 
 if __name__ == "__main__":
