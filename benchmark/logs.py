@@ -151,6 +151,32 @@ def _parse_node(text: str) -> dict:
     # matters.
     out["slo_fired"] = _search_all(r"SLO burn fired: (\S+)", text)
     out["slo_cleared"] = _search_all(r"SLO burn cleared: (\S+)", text)
+    # Incident-ledger lines (utils/incidents.py §5.5r): the run-level
+    # fault→alert→recovery summary and the burn-budget verdict. One
+    # summary per ledger build; the LAST line wins (a rerun supersedes).
+    inc = _search_all(
+        r"Incident ledger: (\d+) incident\(s\), (\d+) alert\(s\) "
+        r"attributed, (\d+) unattributed, (\d+) residual, "
+        r"worst MTTR ([\d.]+) ms",
+        text,
+    )
+    out["incident_ledger"] = (
+        (
+            int(inc[-1][0]),
+            int(inc[-1][1]),
+            int(inc[-1][2]),
+            int(inc[-1][3]),
+            float(inc[-1][4]),
+        )
+        if inc
+        else None
+    )
+    burn = _search_all(
+        r"Burn budget verdict: (ok|violated) "
+        r"\((\d+) SLO row\(s\) over budget\)",
+        text,
+    )
+    out["burn_verdict"] = (burn[-1][0], int(burn[-1][1])) if burn else None
     # Reconfiguration / catch-up lines (consensus/reconfig.py +
     # synchronizer.py + core.py): epoch switches with their activation
     # rounds, and range-sync start lag / fetched-block progress.
@@ -371,6 +397,17 @@ class LogParser:
         self.watchdog_dumps: list[str] = []  # recorder dump paths
         self.slo_fired: list[str] = []  # SLO burn alerts across nodes
         self.slo_cleared: list[str] = []
+        # Incident-ledger fold (one summary line per ledger build): counts
+        # sum across logs that carried one, worst MTTR takes the max, and
+        # the burn verdict is 'violated' if ANY log said violated.
+        self.incident_count = 0
+        self.incident_attributed = 0
+        self.incident_unattributed = 0
+        self.incident_residual = 0
+        self.incident_worst_mttr_ms = 0.0
+        self.incident_ledgers = 0
+        self.burn_verdict: str | None = None
+        self.burn_over = 0
         # (epoch, activation round) per switch line across nodes, and the
         # per-range-sync start lags / fetched-block totals (catch-up).
         self.epoch_switches: list[tuple[int, int]] = []
@@ -447,6 +484,21 @@ class LogParser:
             self.watchdog_dumps.extend(r.get("watchdog_dumps", []))
             self.slo_fired.extend(r.get("slo_fired", []))
             self.slo_cleared.extend(r.get("slo_cleared", []))
+            if r.get("incident_ledger") is not None:
+                n_inc, att, unatt, resid, worst = r["incident_ledger"]
+                self.incident_count += n_inc
+                self.incident_attributed += att
+                self.incident_unattributed += unatt
+                self.incident_residual += resid
+                self.incident_worst_mttr_ms = max(
+                    self.incident_worst_mttr_ms, worst
+                )
+                self.incident_ledgers += 1
+            if r.get("burn_verdict") is not None:
+                verdict, over = r["burn_verdict"]
+                self.burn_over += over
+                if self.burn_verdict != "violated":
+                    self.burn_verdict = verdict
             self.epoch_switches.extend(r.get("epoch_switches", []))
             self.handoffs.extend(r.get("handoffs", []))
             self.handoff_violations += r.get("handoff_violations", 0)
@@ -714,6 +766,21 @@ class LogParser:
                     f" SLO burn alerts: {len(self.slo_fired)} fired"
                     f" ({names}), {len(self.slo_cleared)} cleared\n"
                 )
+        incidents = ""
+        if self.incident_ledgers:
+            incidents = (
+                " + INCIDENTS:\n"
+                f" Incidents: {self.incident_count}"
+                f" ({self.incident_attributed} alert(s) attributed,"
+                f" {self.incident_unattributed} unattributed,"
+                f" {self.incident_residual} residual)\n"
+                f" Worst MTTR: {self.incident_worst_mttr_ms:,.1f} ms\n"
+            )
+            if self.burn_verdict is not None:
+                incidents += (
+                    f" Burn budget: {self.burn_verdict}"
+                    f" ({self.burn_over} SLO row(s) over)\n"
+                )
         matrix = ""
         if self.matrix_cells:
             greens = sum(1 for _c, v in self.matrix_cells if v == "green")
@@ -840,6 +907,14 @@ class LogParser:
                 "activation round (the epoch-final invariant; gap rounds "
                 "were certified by the old committee)\n"
             )
+        if self.incident_unattributed or self.burn_verdict == "violated":
+            warn += (
+                f" WARNING: incident ledger left "
+                f"{self.incident_unattributed} alert(s) unattributed and "
+                f"judged the burn budget {self.burn_verdict or 'unjudged'} "
+                f"({self.burn_over} SLO row(s) over) — fault attribution "
+                "or the error budget broke down\n"
+            )
         if self.misses:
             warn += f" WARNING: {self.misses} rate-too-high warnings\n"
         if self.timeouts > 2:
@@ -878,6 +953,7 @@ class LogParser:
             + ingress
             + network
             + telemetry
+            + incidents
             + lint
             + matrix
             + agg

@@ -45,7 +45,7 @@ from ..crypto.primitives import Digest, PublicKey, Signature
 from ..crypto.scheduler import SchedulerConfig
 from ..network import net
 from ..store import Store
-from ..utils import metrics, telemetry, tracing
+from ..utils import incidents, metrics, telemetry, tracing
 from ..utils.actors import SpawnScope, channel, spawn
 from .invariants import LivenessChecker, SafetyChecker
 from .plan import FaultPlan, SeededRng
@@ -211,6 +211,7 @@ class ChaosOrchestrator:
         trusted_crypto: bool = False,
         proofs: bool = False,
         proof_squat_rate: float = 0.0,
+        burn_budget: dict[str, float] | None = None,
     ) -> None:
         self.rng = SeededRng(seed)
         self.seed = seed
@@ -363,6 +364,10 @@ class ChaosOrchestrator:
         # burn-rate alerts, embedded per node in the report.
         self.telemetry_config = telemetry_config
         self.telemetry_planes: dict[int, telemetry.TelemetryPlane] = {}
+        # Scenario-declared per-SLO burn budget (seconds-in-violation the
+        # run may spend per SLO row) — judged by the incident ledger's
+        # health block in _report (utils/incidents.py).
+        self.burn_budget = dict(burn_budget) if burn_budget else None
         self.events: list[dict] = []
         # Per-node epoch switches (EpochManager on_switch hook) — the
         # report section the reconfig expectations judge.
@@ -1176,8 +1181,35 @@ class ChaosOrchestrator:
         self.liveness.require_commits(self.honest, min_commits)
         return self._report(loop.time() - start)
 
+    def _injected_windows(self) -> tuple["incidents.FaultWindow", ...]:
+        """Fault windows only the orchestrator can parameterize: injected
+        load spans (their shapes never land in the report's plan)."""
+        windows: list[incidents.FaultWindow] = []
+        if self.flood is not None:
+            windows.append(
+                incidents.FaultWindow(
+                    "flood",
+                    float(self.flood.t_start),
+                    float(self.flood.t_start + self.flood.duration),
+                    None,
+                )
+            )
+        curve = getattr(self.ingress, "curve", None)
+        if curve is not None and getattr(curve, "kind", None) == "flash":
+            # A steady/open-loop curve is background traffic, not a
+            # fault; only the flash spike is an injected disruption.
+            windows.append(
+                incidents.FaultWindow(
+                    "ingress_spike",
+                    float(curve.t_start),
+                    float(curve.t_end),
+                    None,
+                )
+            )
+        return tuple(windows)
+
     def _report(self, elapsed: float) -> dict:
-        return {
+        report = {
             "seed": self.seed,
             "nodes": self.n,
             "byzantine": sorted(self.byzantine),
@@ -1300,6 +1332,22 @@ class ChaosOrchestrator:
             "watchdog_triggers": list(tracing.WATCHDOG.triggers),
             "ok": self.safety.ok() and self.liveness.ok(),
         }
+        # Incident ledger (§5.5r): fault→alert→recovery attribution over
+        # the sections above, embedded so every consumer — expectations,
+        # fleet_rollup, telemetry_dash --incidents, trace_report — reads
+        # ONE materialization. Health never flips the baseline `ok`:
+        # scenarios that want the verdict pin it via expectations, so
+        # legacy cells stay comparable across matrix revisions.
+        ledger = incidents.report_ledger(
+            report,
+            extra_windows=self._injected_windows(),
+            budget=self.burn_budget,
+        )
+        incidents.record_metrics(ledger)
+        incidents.log_ledger(ledger)
+        report["incidents"] = ledger
+        report["health"] = ledger["health"]
+        return report
 
     # -- adversarial bookkeeping (forged-signature scenarios) ----------------
 

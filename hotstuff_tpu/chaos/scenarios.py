@@ -149,6 +149,10 @@ class Scenario:
     # Byzantine nonce-squatting driver: never-admitted MODE_SUBSCRIBE
     # queries/s per target node (0 = off); outcomes in `proof_squat`.
     proof_squat_rate: float = 0.0
+    # Scenario-declared per-SLO burn budget (seconds-in-violation the run
+    # may spend per SLO row, utils/incidents.py §5.5r): judged in the
+    # report's `health` block; rows not named here are reported unjudged.
+    burn_budget: Callable[[], dict[str, float]] | None = None
 
 
 def _expect_counter(deltas: dict, name: str, minimum: int = 1) -> list[str]:
@@ -622,6 +626,335 @@ _register(
         scheduler=lambda: SchedulerConfig(pace_s_per_sig=_SLO_PACE_S_PER_SIG),
         telemetry=_slo_telemetry_config,
         expect=_expect_slo_burn,
+    )
+)
+
+# ---------------------------------------------------------------------------
+# Incident-ledger scenarios (§5.5r, ISSUE 20): the fault→alert→recovery
+# attribution plane's own acceptance runs. incident_smoke is the tier-1
+# regression pin (tests/test_incidents.py replays it twice and requires a
+# bit-identical ledger); operations_day is the slow-tier game day ROADMAP
+# item 4 sketched — rolling restarts across an epoch boundary under
+# sustained ingress, judged by the health verdict instead of counters.
+
+_SMOKE_FLOOD_WINDOW = (1.0, 4.0)  # slo_burn_bulk's proven burn recipe
+_SMOKE_CRASH = (6.8, 7.8)  # after the burn clears (~t=6), before run end
+
+
+def _smoke_ingress_config() -> IngressConfig:
+    # Default (deep) lanes + a mild drain pacer: light traffic admits
+    # cleanly — the smoke's ingress is background load, not the fault.
+    return IngressConfig(verify_batch=4, verify_interval=0.1)
+
+
+def _expect_incident_smoke(report: dict, deltas: dict) -> list[str]:
+    problems = _expect_counter(deltas, "chaos.crashes")
+    problems += _expect_counter(deltas, "chaos.restarts")
+    problems += _expect_counter(deltas, "telemetry.slo_burn_fired")
+    problems += _expect_counter(deltas, "incident.opened", minimum=3)
+    problems += _expect_counter(deltas, "incident.attributed")
+    ledger = report.get("incidents") or {}
+    health = report.get("health") or {}
+    kinds = {r["kind"] for r in ledger.get("incidents", ())}
+    for want in ("flood", "crash", "link_fault"):
+        if want not in kinds:
+            problems.append(
+                f"no {want} incident in the ledger (saw {sorted(kinds)})"
+            )
+    if health.get("alerts_attributed", 0) < 1:
+        problems.append("no alert attributed to any injected fault")
+    if health.get("alerts_unattributed", 0):
+        problems.append(
+            f"{health['alerts_unattributed']} unattributed alert(s): "
+            f"{ledger.get('unattributed')}"
+        )
+    if health.get("residual", 0):
+        problems.append("alert span(s) still open at run end (residual)")
+    if health.get("burn_budget_ok") is not True:
+        problems.append(f"burn budget violated: {health.get('burn')}")
+    if not health.get("ok"):
+        problems.append("health verdict is not green")
+    flood_rows = [
+        r for r in ledger.get("incidents", ()) if r["kind"] == "flood"
+    ]
+    if flood_rows and (
+        flood_rows[0]["mttd_s"] is None or flood_rows[0]["mttr_s"] is None
+    ):
+        problems.append("flood incident carries no MTTD/MTTR")
+    return problems
+
+
+_register(
+    Scenario(
+        name="incident_smoke",
+        description="Leader crash + a lossy link under light ingress while "
+        "a short mempool flood drives one SLO burn fire/clear cycle: the "
+        "incident ledger must attribute every alert to an injected fault "
+        "window (unattributed == 0), carry MTTD/MTTR for the flood, stay "
+        "within the declared burn budget, and replay bit-identically at "
+        "the same seed — the incident plane's tier-1 regression pin.",
+        plan=lambda: FaultPlan(
+            # 150 ms links bound the pure-python wall cost per virtual
+            # second (flash_crowd rationale); the 2<->3 pair additionally
+            # drops 5% — a node-scoped link_fault window in the ledger.
+            default_link=LinkFaults(delay=0.15),
+            links={
+                (2, 3): LinkFaults(delay=0.15, drop=0.05),
+                (3, 2): LinkFaults(delay=0.15, drop=0.05),
+            },
+            crashes=[
+                CrashWindow(
+                    node=1, at=_SMOKE_CRASH[0], restart=_SMOKE_CRASH[1]
+                )
+            ],
+        ),
+        duration=10.0,
+        min_commits=0,  # no early stop: fire, clear, crash must all play
+        heal_t=_SMOKE_CRASH[1],
+        ingress=lambda: IngressLoad(
+            curve=ArrivalCurve(kind="sustained", rate=3.0),
+            duration=9.0,
+            clients=1,
+            tx_bytes=32,
+            config=_smoke_ingress_config,
+        ),
+        flood=lambda: BulkFlood(
+            rate=40.0,
+            group_size=16,
+            duration=_SMOKE_FLOOD_WINDOW[1] - _SMOKE_FLOOD_WINDOW[0],
+            t_start=_SMOKE_FLOOD_WINDOW[0],
+            pool=8,
+        ),
+        scheduler=lambda: SchedulerConfig(pace_s_per_sig=_SLO_PACE_S_PER_SIG),
+        telemetry=_slo_telemetry_config,
+        burn_budget=lambda: {"lane.mempool": 30.0},
+        expect=_expect_incident_smoke,
+    )
+)
+
+# Operations day (ROADMAP item 4's stretch, scoped to the virtual plane):
+# every node rolling-restarts once, one at a time, across a committed
+# epoch boundary, under sustained ingress plus a mid-day mempool surge —
+# pass/fail is the incident plane's verdict (burn budget respected,
+# unattributed == 0, MTTD/MTTR ceilings), not a pile of counters. Runs
+# on the trusted-crypto stub: membership/timing is at stake, not forgery.
+_OPS_CRASH_START = 3.0
+_OPS_CRASH_SPACING = 2.0
+_OPS_CRASH_DOWN = 1.2
+_OPS_SURGE_WINDOW = (8.0, 10.5)  # the mid-day mempool surge (burn source)
+_OPS_MTTD_CEILING_MS = 6_000.0
+_OPS_MTTR_CEILING_MS = 15_000.0
+
+
+def _ops_committee(n: int) -> tuple[int, ...]:
+    """Genesis committee with two join candidates held back: n-2 members
+    keeps quorum with any single member down (the rolling-restart
+    invariant) and leaves candidates for the boundary rotation."""
+    return tuple(range(max(3, n - 2)))
+
+
+def _ops_plan(n: int) -> FaultPlan:
+    return FaultPlan(
+        default_link=LinkFaults(delay=0.1),
+        crashes=[
+            CrashWindow(
+                node=i,
+                at=_OPS_CRASH_START + _OPS_CRASH_SPACING * i,
+                restart=_OPS_CRASH_START + _OPS_CRASH_SPACING * i
+                + _OPS_CRASH_DOWN,
+            )
+            for i in range(n)
+        ],
+    )
+
+
+def _ops_directives(n: int) -> list[ReconfigDirective]:
+    return [ReconfigDirective(at=2.0, rotate=2, activation_margin=_CHURN_MARGIN)]
+
+
+def _expect_operations_day(report: dict, deltas: dict) -> list[str]:
+    n = report["nodes"]
+    problems = _expect_no_handoff_violation(deltas)
+    problems += _expect_counter(deltas, "reconfig.epoch_switches")
+    problems += _expect_counter(deltas, "chaos.crashes", minimum=n)
+    problems += _expect_counter(deltas, "chaos.restarts", minimum=n)
+    # Rotated-out genesis members legitimately stop committing at the
+    # boundary, so the generic heal_t progress gate can't apply fleet-wide
+    # — instead every FINAL-committee member must commit after the LAST
+    # rolling restart: the day ends with the whole committee working.
+    last_restart = max(
+        (e["t"] for e in report["events"] if e["event"] == "restart"),
+        default=0.0,
+    )
+    disagreements, memberships = _switch_memberships(report)
+    problems += disagreements
+    if memberships:
+        _act, final_members = memberships[max(memberships)]
+        for i in sorted(final_members):
+            times = report.get("commit_times", {}).get(str(i), [])
+            if not any(t > last_restart for t in times):
+                problems.append(
+                    f"final-committee node {i} never committed after the "
+                    f"last rolling restart at t={last_restart}"
+                )
+    else:
+        problems.append("no epoch-switch memberships recorded")
+    problems += _expect_counter(deltas, "telemetry.slo_burn_fired")
+    problems += _expect_counter(deltas, "incident.opened", minimum=n + 1)
+    totals = {"offered": 0, "accepted": 0}
+    for summary in report.get("ingress", {}).values():
+        for k in totals:
+            totals[k] += summary.get(k, 0)
+    if not totals["accepted"]:
+        problems.append("sustained ingress admitted nothing all day")
+    ledger = report.get("incidents") or {}
+    health = report.get("health") or {}
+    kinds = [r["kind"] for r in ledger.get("incidents", ())]
+    if kinds.count("crash") < n:
+        problems.append(
+            f"expected {n} crash incidents (one rolling restart per "
+            f"node), saw {kinds.count('crash')}"
+        )
+    if "epoch_switch" not in kinds:
+        problems.append("no epoch_switch incident — the boundary never ran")
+    # The game-day verdict: every alert explained, burn inside budget,
+    # nothing left burning, detection/recovery inside the ceilings.
+    if health.get("alerts_attributed", 0) < 3:
+        problems.append(
+            f"only {health.get('alerts_attributed', 0)} alert(s) "
+            "attributed — the surge never exercised the alert plane"
+        )
+    if health.get("alerts_unattributed", 0):
+        problems.append(
+            f"{health['alerts_unattributed']} unattributed alert(s): "
+            f"{ledger.get('unattributed')}"
+        )
+    if health.get("residual", 0):
+        problems.append("alert span(s) still open at run end (residual)")
+    if health.get("burn_budget_ok") is not True:
+        problems.append(f"burn budget violated: {health.get('burn')}")
+    for kind, s in sorted((health.get("mttd") or {}).items()):
+        if s["p99_ms"] > _OPS_MTTD_CEILING_MS:
+            problems.append(
+                f"{kind} detection p99 {s['p99_ms']:.0f} ms exceeds the "
+                f"{_OPS_MTTD_CEILING_MS:.0f} ms ceiling"
+            )
+    for kind, s in sorted((health.get("mttr") or {}).items()):
+        if s["p99_ms"] > _OPS_MTTR_CEILING_MS:
+            problems.append(
+                f"{kind} recovery p99 {s['p99_ms']:.0f} ms exceeds the "
+                f"{_OPS_MTTR_CEILING_MS:.0f} ms ceiling"
+            )
+    if not health.get("ok"):
+        problems.append("health verdict is not green")
+    return problems
+
+
+_register(
+    Scenario(
+        name="operations_day",
+        description="A production game day on the virtual clock: all "
+        "seven nodes rolling-restart one at a time across a committed "
+        "epoch boundary (two members rotate at the boundary) under "
+        "sustained client ingress, with a mid-day mempool surge driving "
+        "the SLO burn plane — pass/fail is the incident ledger's health "
+        "verdict: every alert attributed to an injected fault, the "
+        "declared burn budget respected, no residual alerts, and "
+        "MTTD/MTTR p99 inside the ceilings.",
+        n=7,
+        committee_n=_ops_committee,
+        plan_n=_ops_plan,
+        reconfig_n=_ops_directives,
+        duration=22.0,
+        min_commits=0,  # no early stop: the whole day must play out
+        # No heal_t: nodes rotated out at the boundary stop committing by
+        # design; the expectation pins final-committee progress instead.
+        slow=True,
+        trusted_crypto=True,
+        ingress=lambda: IngressLoad(
+            curve=ArrivalCurve(kind="sustained", rate=4.0),
+            duration=20.0,
+            clients=2,
+            tx_bytes=32,
+        ),
+        flood=lambda: BulkFlood(
+            rate=40.0,
+            group_size=16,
+            duration=_OPS_SURGE_WINDOW[1] - _OPS_SURGE_WINDOW[0],
+            t_start=_OPS_SURGE_WINDOW[0],
+            pool=8,
+        ),
+        scheduler=lambda: SchedulerConfig(pace_s_per_sig=_SLO_PACE_S_PER_SIG),
+        telemetry=_slo_telemetry_config,
+        burn_budget=lambda: {
+            "lane.mempool": 60.0,
+            "lane.consensus": 2.0,
+        },
+        expect=_expect_operations_day,
+    )
+)
+
+
+def _expect_flood_cell(report: dict, deltas: dict) -> list[str]:
+    """flash_crowd's contract, size-parameterized for the matrix grid:
+    shed>0 with a retry hint on every shed, the commit plateau held
+    through the spike, no node starved outright, and the ledger carries
+    the spike window with zero unattributed alerts."""
+    problems = _expect_flash_crowd(report, deltas)
+    starved = [
+        int(i)
+        for i, rounds in sorted(
+            report.get("commits", {}).items(), key=lambda kv: int(kv[0])
+        )
+        if not rounds
+    ]
+    if starved:
+        problems.append(f"nodes with zero commits under the flood: {starved}")
+    ledger = report.get("incidents") or {}
+    health = report.get("health") or {}
+    if "ingress_spike" not in {
+        r["kind"] for r in ledger.get("incidents", ())
+    }:
+        problems.append("no ingress_spike incident in the ledger")
+    if health.get("alerts_unattributed", 0):
+        problems.append(
+            f"{health['alerts_unattributed']} unattributed alert(s) in a "
+            f"flood cell: {ledger.get('unattributed')}"
+        )
+    return problems
+
+
+_register(
+    Scenario(
+        name="flood",
+        description="flash_crowd_ingress, grid-shaped (ROADMAP item 3's "
+        "flood-cell residue): the identical open-loop 4 -> 60 tx/s flash "
+        "crowd per node, with the expectations size-parameterized — shed "
+        "with retry hints, plateau held, no starved node at any committee "
+        "size — and the spike window pinned in the incident ledger. Slow "
+        "tier standalone (the tier-1 copy of this machinery is "
+        "flash_crowd_ingress); its home is the matrix grid.",
+        plan=lambda: FaultPlan(default_link=LinkFaults(delay=0.15)),
+        duration=11.0,
+        # The spike machinery ends at t=10; running a cell to the 30 s
+        # grid cap would soak 19 empty virtual seconds per cell.
+        cell_duration=11.0,
+        min_commits=0,  # no early stop: the spike window must play out
+        slow=True,
+        ingress=lambda: IngressLoad(
+            curve=ArrivalCurve(
+                kind="flash",
+                rate=4,
+                peak=60,
+                t_start=_FLASH_SPIKE[0],
+                t_end=_FLASH_SPIKE[1],
+            ),
+            duration=10.0,
+            clients=3,
+            tx_bytes=32,
+            config=_flash_ingress_config,
+        ),
+        expect=_expect_flood_cell,
     )
 )
 
@@ -1907,6 +2240,11 @@ MATRIX_SCENARIOS = (
     # submit→commit→proof loop at n=4 and n=64 — every served proof
     # client-verified, none of the committed admissions unprovable.
     "ingress_proofs",
+    # ISSUE 20's flood cells (ROADMAP item 3's flash-crowd residue):
+    # flash_crowd_ingress grid-shaped — shed with retry hints, plateau
+    # held, no starved node, the spike window pinned in the incident
+    # ledger — at n=4 and (trusted-stub) n=64.
+    "flood",
 )
 MATRIX_SEEDS = (1, 2)
 MATRIX_SIZES = (4, 64)
@@ -2020,6 +2358,7 @@ def run_matrix_cell(
 _DELTA_PREFIXES = (
     "chaos.", "verifier.", "consensus.", "net.", "ingress.", "scheduler.",
     "telemetry.", "sync.", "reconfig.", "wan.", "agg.", "elect.", "proofs.",
+    "incident.",
 )
 
 
@@ -2106,6 +2445,7 @@ def run_scenario(
             trusted_crypto=trusted_crypto or scenario.trusted_crypto,
             proofs=scenario.proofs,
             proof_squat_rate=scenario.proof_squat_rate,
+            burn_budget=scenario.burn_budget() if scenario.burn_budget else None,
         )
         report = await orch.run(
             duration if duration is not None else scenario.duration,

@@ -731,6 +731,14 @@ _DEFAULT_NAMESPACE: tuple[tuple[str, str, tuple[float, ...] | None], ...] = (
     ("telemetry.slo_burn_cleared", "counter", None),
     ("telemetry.scrapes", "counter", None),
     ("telemetry.peer_views", "counter", None),
+    # utils/incidents.py — run-level incident ledger (fault→alert→
+    # recovery attribution, fleet MTTR accounting, burn budgets)
+    ("incident.opened", "counter", None),
+    ("incident.attributed", "counter", None),
+    ("incident.unattributed", "counter", None),
+    ("incident.mttd_s", "histogram", None),
+    ("incident.mttr_s", "histogram", None),
+    ("incident.budget_burn_s", "histogram", None),
     # ops/timeline.py — device-occupancy timeline
     ("timeline.intervals", "counter", None),
     ("timeline.dropped", "counter", None),

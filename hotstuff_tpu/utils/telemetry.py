@@ -826,7 +826,7 @@ def peer_latency_map(peers: dict[str, dict]) -> dict[str, dict[str, float]]:
 # the scale/health counters a regression diff is judged on, not the full
 # delta dump (which stays in the per-scenario report).
 _ROLLUP_COUNTER_PREFIXES = (
-    "sync.", "reconfig.", "wan.", "chaos.", "agg.", "elect.",
+    "sync.", "reconfig.", "wan.", "chaos.", "agg.", "elect.", "incident.",
 )
 
 
@@ -1019,6 +1019,10 @@ def fleet_rollup(report: dict) -> dict:
         },
         "peer_rtt": peer_rtt,
         "election": election,
+        # Incident-ledger health verdict (utils/incidents.py §5.5r):
+        # MTTD/MTTR percentiles per fault class, burn budget, and the
+        # unattributed-alert count — the matrix cell's operations view.
+        "health": report.get("health"),
         "fault_trace_truncated": bool(report.get("fault_trace_truncated")),
     }
 

@@ -92,6 +92,9 @@ _FAST = [
         "rolling_churn",
         "boundary_quorum_crash",
         "multi_epoch_catchup",
+        # targeted determinism pin in tests/test_incidents.py (the sweep
+        # copy would re-run the same ~5 s cell for no new coverage)
+        "incident_smoke",
     )
 ]
 
@@ -335,12 +338,19 @@ def test_epoch_reconfig_join_leave_at_committed_boundary():
     assert report["metrics"]["sync.range_blocks"] >= 3
 
 
+@pytest.mark.slow
 def test_epoch_reconfig_deterministic():
     """Same seed => bit-identical fault trace, commit sequence, AND
     epoch-switch events (the ISSUE acceptance wording). Truncated
     duration bounds the pure-python wall cost (the bulk_flood
     determinism-test rationale): the directive, commit, switch and the
-    joiner's catch-up all land inside 9 virtual seconds."""
+    joiner's catch-up all land inside 9 virtual seconds.
+
+    Tier-1 diet (ISSUE 20): demoted to slow — epoch-switch bit-identity
+    stays pinned tier-1 by test_rolling_churn_replays_bit_identically,
+    and the epoch_reconfig behaviour itself by
+    test_epoch_reconfig_join_leave_at_committed_boundary; this exact-
+    pysigner double-run re-proved the same two facts for ~5 s of wall."""
     a = run_scenario("epoch_reconfig", seed=42, duration=9.0)
     b = run_scenario("epoch_reconfig", seed=42, duration=9.0)
     assert a["fault_trace"] == b["fault_trace"]

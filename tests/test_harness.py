@@ -1048,6 +1048,56 @@ def test_log_parser_scrapes_telemetry_lines():
     assert "SLO burn alerts: 1 fired (lane.mempool), 1 cleared" in out
 
 
+def test_log_parser_scrapes_incident_lines():
+    """Incident-ledger summary and burn-budget verdict lines
+    (utils/incidents.py) fold into the report's `+ INCIDENTS:` section:
+    counts summed across logs, worst MTTR maxed, 'violated' sticky over
+    'ok'. The LAST summary per log wins (a rerun supersedes), and a
+    nonzero unattributed count raises a WARNING. Absent when quiet."""
+    from benchmark.logs import LogParser
+
+    assert "+ INCIDENTS:" not in LogParser([CLIENT_LOG], [NODE_LOG]).result()
+    node_a = NODE_LOG + (
+        "[2026-07-30T10:00:09.000Z INFO hotstuff.incidents] Incident "
+        "ledger: 3 incident(s), 8 alert(s) attributed, 0 unattributed, "
+        "0 residual, worst MTTR 5500.0 ms\n"
+        "[2026-07-30T10:00:09.100Z INFO hotstuff.incidents] Burn budget "
+        "verdict: ok (0 SLO row(s) over budget)\n"
+    )
+    node_b = NODE_LOG + (
+        # superseded by the later rerun line below (LAST wins)
+        "[2026-07-30T10:00:05.000Z INFO hotstuff.incidents] Incident "
+        "ledger: 9 incident(s), 9 alert(s) attributed, 9 unattributed, "
+        "9 residual, worst MTTR 9.0 ms\n"
+        "[2026-07-30T10:00:09.000Z INFO hotstuff.incidents] Incident "
+        "ledger: 2 incident(s), 1 alert(s) attributed, 1 unattributed, "
+        "1 residual, worst MTTR 250.5 ms\n"
+        "[2026-07-30T10:00:09.100Z INFO hotstuff.incidents] Burn budget "
+        "verdict: violated (2 SLO row(s) over budget)\n"
+    )
+    p = LogParser([CLIENT_LOG], [node_a, node_b])
+    assert p.incident_ledgers == 2
+    assert p.incident_count == 5
+    assert p.incident_attributed == 9
+    assert p.incident_unattributed == 1
+    assert p.incident_residual == 1
+    assert p.incident_worst_mttr_ms == 5500.0
+    assert p.burn_verdict == "violated" and p.burn_over == 2
+    out = p.result()
+    assert "+ INCIDENTS:" in out
+    assert (
+        "Incidents: 5 (9 alert(s) attributed, 1 unattributed, 1 residual)"
+        in out
+    )
+    assert "Worst MTTR: 5,500.0 ms" in out
+    assert "Burn budget: violated (2 SLO row(s) over)" in out
+    assert "WARNING: incident ledger left 1 alert(s) unattributed" in out
+    # clean ledger: section renders, no warning
+    clean = LogParser([CLIENT_LOG], [node_a]).result()
+    assert "Burn budget: ok (0 SLO row(s) over)" in clean
+    assert "WARNING: incident ledger" not in clean
+
+
 # ---------------------------------------------------------------------------
 # Scenario-registry lint (tools/lint_metrics.py lint_scenarios) + the
 # LogParser RECONFIG section (benchmark/logs.py)
@@ -1348,6 +1398,31 @@ def test_lint_matrix_flags_unknown_and_committee_pinned_grid(monkeypatch):
     assert any(
         "epoch_reconfig" in p and "committee" in p for p in problems
     )
+
+
+def test_lint_incidents_clean_on_repo():
+    """Every AnomalyWatchdog trigger reason classifies into a ledger
+    alert class and every incident.* metric row is registered — today's
+    tree is clean."""
+    assert _load_lint().lint_incidents() == []
+
+
+def test_lint_incidents_flags_unmapped_and_stale_reasons(monkeypatch):
+    """An unmapped watchdog reason (its triggers would all land in
+    `unattributed`) and a stale classification (maps a reason nothing
+    emits) are both violations."""
+    from hotstuff_tpu.utils import incidents
+
+    lint = _load_lint()
+    mutated = dict(incidents.WATCHDOG_ALERT_CLASSES)
+    mutated.pop("round_stall")
+    mutated["ghost_reason"] = "ghost"
+    monkeypatch.setattr(incidents, "WATCHDOG_ALERT_CLASSES", mutated)
+    problems = lint.lint_incidents()
+    assert any(
+        "'round_stall'" in p and "unattributed" in p for p in problems
+    )
+    assert any("'ghost_reason'" in p and "stale" in p for p in problems)
 
 
 def test_log_parser_matrix_section():

@@ -1,0 +1,370 @@
+"""Incident-ledger tests (utils/incidents.py §5.5r): hand-computed
+attribution on synthetic fault/alert streams, fleet MTTD/MTTR percentile
+math, the burn-budget verdict, the incident_smoke tier-1 determinism pin
+(same seed => bit-identical ledger), and the slow-tier operations_day /
+flood acceptance runs.
+
+Dependency-free (no `cryptography`, no jax): the ledger is a pure
+function of report data, and the scenario runs ride the chaos plane's
+pysigner + VirtualTimeLoop stack.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from hotstuff_tpu.utils.incidents import (
+    ATTRIBUTION_GRACE_S,
+    AlertSpan,
+    FaultWindow,
+    WATCHDOG_ALERT_CLASSES,
+    alert_spans_from_report,
+    build_ledger,
+    fault_windows_from_report,
+    worst_mttr_ms,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# --- attribution on synthetic streams ---------------------------------------
+
+
+def test_alert_inside_fault_window_attributes_with_mttd_mttr():
+    """The base case, hand-computed: a crash [10, 14] on node 1 whose SLO
+    alert fires at 12 and clears at 15 -> MTTD 2 s, MTTR 5 s."""
+    ledger = build_ledger(
+        [FaultWindow("crash", 10.0, 14.0, (1,))],
+        [AlertSpan("slo_burn", "lane.mempool", 1, 12.0, 15.0)],
+        run_end=20.0,
+    )
+    (row,) = ledger["incidents"]
+    assert row["kind"] == "crash"
+    assert row["alerts"] == 1 and row["alert_classes"] == {"slo_burn": 1}
+    assert row["mttd_s"] == 2.0 and row["mttr_s"] == 5.0
+    assert not row["residual"]
+    assert ledger["unattributed"] == []
+    h = ledger["health"]
+    assert h["ok"] and h["alerts_attributed"] == 1
+    assert h["mttd"]["crash"]["p50_ms"] == 2000.0
+    assert h["mttr"]["crash"]["p50_ms"] == 5000.0
+    assert worst_mttr_ms(ledger) == 5000.0
+
+
+def test_alert_before_fault_is_never_explained_by_it():
+    """Causality: an alert that FIRED before the fault started cannot be
+    attributed to it, even though its lifetime overlaps the window — it
+    lands in the unattributed class and flips the health verdict."""
+    ledger = build_ledger(
+        [FaultWindow("link_fault", 10.0, 20.0, None)],
+        [AlertSpan("slo_burn", "lane.ingress", 0, 9.5, 12.0)],
+        run_end=30.0,
+    )
+    assert ledger["incidents"][0]["alerts"] == 0
+    (u,) = ledger["unattributed"]
+    assert u["name"] == "lane.ingress" and u["fired"] == 9.5
+    assert not ledger["health"]["ok"]
+    assert ledger["health"]["alerts_unattributed"] == 1
+
+
+def test_nested_fault_windows_latest_start_wins():
+    """A node-scoped crash nested inside a fleet-wide flood: the crash
+    node's alert goes to the crash (the innermost, latest-starting
+    cover); other nodes' alerts go to the flood."""
+    ledger = build_ledger(
+        [
+            FaultWindow("flood", 5.0, 15.0, None),
+            FaultWindow("crash", 8.0, 10.0, (2,)),
+        ],
+        [
+            AlertSpan("slo_burn", "lane.mempool", 0, 9.0, 11.0),
+            AlertSpan("slo_burn", "lane.mempool", 2, 9.0, 11.0),
+        ],
+        run_end=20.0,
+    )
+    by_kind = {r["kind"]: r for r in ledger["incidents"]}
+    assert by_kind["flood"]["alerts"] == 1  # node 0
+    assert by_kind["crash"]["alerts"] == 1  # node 2, innermost cover
+    assert by_kind["crash"]["mttd_s"] == 1.0
+    assert ledger["unattributed"] == []
+
+
+def test_grace_period_covers_post_heal_alerts_and_no_further():
+    """An alert firing within ATTRIBUTION_GRACE_S of the window's end is
+    still the fault's echo; one past the grace is unattributed."""
+    windows = [FaultWindow("flood", 1.0, 4.0, None)]
+    inside = build_ledger(
+        windows,
+        [AlertSpan("slo_burn", "lane.a", 0, 4.0 + ATTRIBUTION_GRACE_S, 9.5)],
+        run_end=30.0,
+    )
+    assert inside["incidents"][0]["alerts"] == 1
+    past = build_ledger(
+        windows,
+        [
+            AlertSpan(
+                "slo_burn", "lane.a", 0, 4.0 + ATTRIBUTION_GRACE_S + 0.1, 9.7
+            )
+        ],
+        run_end=30.0,
+    )
+    assert past["incidents"][0]["alerts"] == 0
+    assert len(past["unattributed"]) == 1
+
+
+def test_fire_without_clear_is_residual_and_blocks_mttr():
+    """An attributed alert that never clears marks the incident residual:
+    MTTD still holds, MTTR stays None (recovery never happened), and the
+    health block counts the residual."""
+    ledger = build_ledger(
+        [FaultWindow("crash", 2.0, None, (0,))],
+        [AlertSpan("slo_burn", "lane.mempool", 0, 3.0, None)],
+        run_end=10.0,
+    )
+    (row,) = ledger["incidents"]
+    assert row["residual"] and row["mttd_s"] == 1.0 and row["mttr_s"] is None
+    h = ledger["health"]
+    assert h["residual"] == 1
+    assert "crash" in h["mttd"] and "crash" not in h["mttr"]
+    # an open slo_burn span burns until run_end: 10 - 3 = 7 s
+    assert h["burn"]["lane.mempool"]["burn_s"] == 7.0
+
+
+def test_node_scoped_window_rejects_other_nodes_alerts():
+    ledger = build_ledger(
+        [FaultWindow("crash", 1.0, 2.0, (1,))],
+        [AlertSpan("slo_burn", "lane.x", 3, 1.5, 1.8)],
+        run_end=5.0,
+    )
+    assert ledger["incidents"][0]["alerts"] == 0
+    assert len(ledger["unattributed"]) == 1
+    # ...but a node-less (process-global watchdog) span attributes fine
+    ledger = build_ledger(
+        [FaultWindow("crash", 1.0, 2.0, (1,))],
+        [AlertSpan("stall", "watchdog.round_stall", None, 1.5, 1.5)],
+        run_end=5.0,
+    )
+    assert ledger["incidents"][0]["alerts"] == 1
+
+
+def test_fleet_percentiles_merge_nodes_per_fault_class():
+    """Four nodes detect the same flood at 1/2/3/4 s: the fleet MTTD row
+    merges them via merge_lane_summaries — nearest-rank p50/p99 over the
+    per-node summaries, worst node named."""
+    spans = [
+        AlertSpan("slo_burn", "lane.mempool", i, 10.0 + 1.0 + i, 20.0 + i)
+        for i in range(4)
+    ]
+    ledger = build_ledger(
+        [FaultWindow("flood", 10.0, 18.0, None)], spans, run_end=40.0
+    )
+    mttd = ledger["health"]["mttd"]["flood"]
+    assert mttd["count"] == 4
+    assert mttd["p50_ms"] == 2000.0  # nearest-rank over {1,2,3,4} s
+    assert mttd["max_ms"] == 4000.0
+    assert mttd["worst_node"] == "3"
+    mttr = ledger["health"]["mttr"]["flood"]
+    assert mttr["max_ms"] == 13000.0  # node 3: cleared 23 - start 10
+    assert worst_mttr_ms(ledger) == 13000.0
+
+
+def test_burn_budget_verdict_declared_rows_only():
+    """Burn sums seconds-in-violation per SLO row; only declared rows are
+    judged (within_budget None otherwise) and one over-budget row flips
+    burn_budget_ok and health.ok even with every alert attributed."""
+    windows = [FaultWindow("flood", 0.0, 10.0, None)]
+    spans = [
+        AlertSpan("slo_burn", "lane.mempool", 0, 1.0, 4.0),  # 3 s
+        AlertSpan("slo_burn", "lane.mempool", 0, 6.0, 8.0),  # +2 s
+        AlertSpan("slo_burn", "lane.ingress", 1, 2.0, 3.0),  # 1 s, unjudged
+    ]
+    ok = build_ledger(
+        windows, spans, run_end=10.0, budget={"lane.mempool": 5.0}
+    )
+    assert ok["health"]["burn"]["lane.mempool"] == {
+        "burn_s": 5.0,
+        "budget_s": 5.0,
+        "within_budget": True,
+    }
+    assert ok["health"]["burn"]["lane.ingress"]["within_budget"] is None
+    assert ok["health"]["burn_budget_ok"] and ok["health"]["ok"]
+    over = build_ledger(
+        windows, spans, run_end=10.0, budget={"lane.mempool": 4.9}
+    )
+    assert not over["health"]["burn_budget_ok"]
+    assert not over["health"]["ok"]
+    assert over["health"]["alerts_unattributed"] == 0
+    # a declared row that never burned is still judged (and passes)
+    idle = build_ledger(windows, [], run_end=10.0, budget={"lane.idle": 1.0})
+    assert idle["health"]["burn"]["lane.idle"] == {
+        "burn_s": 0.0,
+        "budget_s": 1.0,
+        "within_budget": True,
+    }
+
+
+# --- report adapters --------------------------------------------------------
+
+
+def test_fault_windows_skip_delay_only_links_and_pair_crash_events():
+    """delay/jitter links are geometry, not faults; drop links window the
+    touched nodes; crash/restart event pairs become node windows with an
+    unpaired crash left open."""
+    report = {
+        "virtual_seconds": 30.0,
+        "plan": {
+            "default_link": {"delay": 0.15, "jitter": 0.01, "drop": 0.0},
+            "links": {"2->3": {"delay": 0.15, "drop": 0.05}},
+            "partitions": [],
+            "crashes": [],
+            "boots": [],
+        },
+        "events": [
+            {"t": 5.0, "event": "crash", "node": 1},
+            {"t": 7.0, "event": "restart", "node": 1},
+            {"t": 20.0, "event": "crash", "node": 2},
+        ],
+    }
+    windows = fault_windows_from_report(report)
+    kinds = [(w.kind, w.start, w.end, w.nodes) for w in windows]
+    assert ("link_fault", 0.0, 30.0, (2, 3)) in kinds
+    assert ("crash", 5.0, 7.0, (1,)) in kinds
+    assert ("crash", 20.0, None, (2,)) in kinds
+    assert all(k != "link_fault" or n is not None for k, _s, _e, n in kinds)
+
+
+def test_alert_spans_pair_fifo_and_skip_watchdog_slo_burn_echo():
+    """Per-node telemetry alerts pair fire->clear FIFO per SLO name; the
+    watchdog's slo_burn triggers are the SAME events mirrored via
+    note_slo_burn and must not double-count."""
+    report = {
+        "telemetry": {
+            "0": {
+                "alerts": [
+                    {"slo": "lane.a", "event": "fired", "t": 1.0},
+                    {"slo": "lane.a", "event": "cleared", "t": 2.0},
+                    {"slo": "lane.a", "event": "fired", "t": 3.0},
+                ]
+            }
+        },
+        "watchdog_triggers": [
+            {"t": 1.0, "reason": "slo_burn", "slo": "lane.a"},
+            {"t": 4.0, "reason": "round_stall", "round": 9},
+        ],
+    }
+    spans = alert_spans_from_report(report)
+    assert (
+        AlertSpan("slo_burn", "lane.a", 0, 1.0, 2.0) in spans
+    )
+    assert AlertSpan("slo_burn", "lane.a", 0, 3.0, None) in spans
+    stalls = [s for s in spans if s.alert_class == "stall"]
+    assert stalls == [AlertSpan("stall", "round_stall", None, 4.0, 4.0)]
+    assert len([s for s in spans if s.alert_class == "slo_burn"]) == 2
+
+
+def test_every_watchdog_reason_classifies():
+    """Mirror of the graftlint `incidents` pass, pinned as a test too:
+    tracing.py's trigger vocabulary stays classified."""
+    assert set(WATCHDOG_ALERT_CLASSES) == {
+        "round_stall",
+        "backpressure",
+        "slo_burn",
+        "handoff_violation",
+        "verify_regression",
+    }
+
+
+# --- the scenarios ----------------------------------------------------------
+
+
+def test_incident_smoke_ledger_bit_identical_across_runs():
+    """The tier-1 pin: incident_smoke (leader crash + lossy link + one
+    SLO burn cycle under light ingress) passes its expectations, and the
+    same seed yields a BIT-IDENTICAL ledger — the ledger is a pure
+    function of the run, fit for committed baselines."""
+    from hotstuff_tpu.chaos import run_scenario
+
+    a = run_scenario("incident_smoke", 11)
+    b = run_scenario("incident_smoke", 11)
+    assert a["ok"], (
+        a["expectation_failures"],
+        a["safety_violations"],
+        a["liveness_violations"],
+    )
+    assert json.dumps(a["incidents"], sort_keys=True) == json.dumps(
+        b["incidents"], sort_keys=True
+    )
+    h = a["health"]
+    assert h["ok"] and h["alerts_unattributed"] == 0 and h["residual"] == 0
+    kinds = {r["kind"] for r in a["incidents"]["incidents"]}
+    assert {"flood", "crash", "link_fault"} <= kinds
+
+
+def test_incidents_module_imports_jax_free():
+    """utils/incidents.py stays importable (and ledger-buildable) with
+    jax hidden — the chaos plane's no-deps contract."""
+    code = (
+        "import sys\n"
+        "sys.modules['jax'] = None\n"
+        "sys.modules['jax.numpy'] = None\n"
+        "from hotstuff_tpu.utils.incidents import ("
+        "AlertSpan, FaultWindow, build_ledger)\n"
+        "led = build_ledger("
+        "[FaultWindow('crash', 1.0, 2.0, (0,))],"
+        "[AlertSpan('slo_burn', 'lane.x', 0, 1.5, 1.8)], run_end=5.0)\n"
+        "assert led['health']['ok']\n"
+        "print('incidents-jax-free-ok')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "incidents-jax-free-ok" in proc.stdout
+
+
+@pytest.mark.slow
+def test_operations_day_passes_the_slo_judged_game_day():
+    """The slow-tier game day: seven nodes rolling-restart across a
+    committed epoch boundary under sustained ingress with a mid-day
+    mempool surge — judged by the ledger's health verdict (every alert
+    attributed, burn budget respected, no residual, MTTD/MTTR p99 under
+    the ceilings), plus final-committee progress after the last restart."""
+    from hotstuff_tpu.chaos import run_scenario
+
+    r = run_scenario("operations_day", 11)
+    assert r["ok"], (
+        r["expectation_failures"],
+        r["safety_violations"],
+        r["liveness_violations"],
+    )
+    h = r["health"]
+    assert h["ok"] and h["alerts_unattributed"] == 0
+    assert h["burn_budget_ok"] and h["residual"] == 0
+    kinds = [row["kind"] for row in r["incidents"]["incidents"]]
+    assert kinds.count("crash") == 7 and "epoch_switch" in kinds
+
+
+@pytest.mark.slow
+def test_flood_cell_scales_to_the_grid():
+    """The matrix 'flood' scenario standalone at the base size: the
+    flash-crowd contract (shed with retry hints, plateau held) plus the
+    grid-shaped additions — no starved node, spike window in the ledger,
+    zero unattributed alerts."""
+    from hotstuff_tpu.chaos import run_scenario
+
+    r = run_scenario("flood", 1)
+    assert r["ok"], (
+        r["expectation_failures"],
+        r["safety_violations"],
+        r["liveness_violations"],
+    )
+    kinds = {row["kind"] for row in r["incidents"]["incidents"]}
+    assert "ingress_spike" in kinds
+    assert r["health"]["alerts_unattributed"] == 0

@@ -334,6 +334,63 @@ def _lint_wan_election_family(matrix_scenarios, scenarios) -> list[str]:
     return problems
 
 
+def lint_incidents() -> list[str]:
+    """The incident ledger's attribution contract (§5.5r): every
+    AnomalyWatchdog trigger reason must resolve to a ledger alert class
+    (an unmapped reason would land every such trigger in `unattributed`
+    and silently flip scenario health verdicts), and the incident.*
+    metric rows the ledger records into must exist in the canonical
+    namespace. The watchdog reasons are recovered from tracing.py's
+    `_trigger("…")` call sites by regex — the same string-literal scan
+    discipline as the namespace pass — so adding a trigger without
+    classifying it fails lint, not a chaos run three PRs later."""
+    from hotstuff_tpu.utils.incidents import WATCHDOG_ALERT_CLASSES
+    from hotstuff_tpu.utils.metrics import _DEFAULT_NAMESPACE
+
+    problems: list[str] = []
+    rows = {name for name, _kind, _b in _DEFAULT_NAMESPACE}
+    for want in (
+        "incident.opened",
+        "incident.attributed",
+        "incident.unattributed",
+        "incident.mttd_s",
+        "incident.mttr_s",
+        "incident.budget_burn_s",
+    ):
+        if want not in rows:
+            problems.append(
+                f"incident ledger metric row {want!r} is missing from "
+                "metrics._DEFAULT_NAMESPACE — record_metrics() would "
+                "mint an off-schema name"
+            )
+    tracing_py = os.path.join(
+        os.path.dirname(__file__), "..", "..", "hotstuff_tpu", "utils",
+        "tracing.py",
+    )
+    with open(tracing_py, encoding="utf-8") as f:
+        text = f.read()
+    reasons = set(re.findall(r"""_trigger\(\s*["']([^"'{}]+)["']""", text))
+    if not reasons:
+        problems.append(
+            "no _trigger(\"…\") call sites found in utils/tracing.py — "
+            "the watchdog-reason scan went blind (regex drift?)"
+        )
+    for reason in sorted(reasons - set(WATCHDOG_ALERT_CLASSES)):
+        problems.append(
+            f"AnomalyWatchdog reason {reason!r} has no entry in "
+            "incidents.WATCHDOG_ALERT_CLASSES — its triggers would all "
+            "land in the ledger's `unattributed` bucket and flip every "
+            "health verdict that pins unattributed == 0"
+        )
+    for reason in sorted(set(WATCHDOG_ALERT_CLASSES) - reasons):
+        problems.append(
+            f"incidents.WATCHDOG_ALERT_CLASSES maps {reason!r}, which no "
+            "_trigger(\"…\") call site in utils/tracing.py emits — stale "
+            "classification (reason renamed or removed?)"
+        )
+    return problems
+
+
 # ---------------------------------------------------------------------------
 # graftlint pass wrappers
 
@@ -430,4 +487,16 @@ def run_matrix(ctx: Context) -> list[Finding]:
         return []
     return _wrap(
         ctx, "matrix", "hotstuff_tpu/chaos/scenarios.py", lint_matrix()
+    )
+
+
+@register("incidents", "watchdog reasons classify; incident.* rows exist")
+def run_incidents(ctx: Context) -> list[Finding]:
+    if not _is_repo_root(ctx):
+        return []
+    return _wrap(
+        ctx,
+        "incidents",
+        "hotstuff_tpu/utils/incidents.py",
+        lint_incidents(),
     )

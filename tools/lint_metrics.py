@@ -9,7 +9,8 @@ The six lints that used to live here — the metric/trace/source-class
 namespace scan, the scheduler starvation lint, the telemetry SLO lint,
 the pipeline timeline-stage lint, the chaos scenario-registry lint, and
 the matrix-grid lint — are now graftlint passes (`namespace`,
-`scheduler`, `telemetry`, `pipeline`, `scenarios`, `matrix`;
+`scheduler`, `telemetry`, `pipeline`, `scenarios`, `matrix`, plus the
+later `incidents` watchdog-classification lint;
 tools/graftlint/metrics_passes.py carries the full rationale for each).
 This shim pins the original CLI contract for callers and CI recipes
 that predate the fold:
@@ -34,6 +35,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from tools.graftlint.metrics_passes import (  # noqa: E402,F401
+    lint_incidents,
     lint_matrix,
     lint_pipeline,
     lint_scenarios,
@@ -68,6 +70,7 @@ def run(root: str) -> list[str]:
         + lint_pipeline()
         + lint_scenarios()
         + lint_matrix()
+        + lint_incidents()
     )
 
 

@@ -20,6 +20,12 @@ device occupancy, and SLO burn alerts — one renderer for both sources.
     # from this node's vantage (gap clustering, network/net.py)
     python tools/telemetry_dash.py --report chaos.json --peers
 
+    # incident ledger (utils/incidents.py §5.5r): one row per fault
+    # window — attributed alerts, MTTD/MTTR, residual flags — plus the
+    # burn-budget rows and any unattributed alerts (report-only: the
+    # ledger is a run-level artifact, not a live scrape)
+    python tools/telemetry_dash.py --report chaos.json --incidents
+
     # machine-readable (same normalized records either way)
     python tools/telemetry_dash.py --report chaos.json --json
 
@@ -342,6 +348,86 @@ def render_peers(records: list[dict], mode: str) -> str:
     return "\n".join(lines)
 
 
+def _fmt_s(v) -> str:
+    return f"{v:.3f}" if isinstance(v, (int, float)) else "-"
+
+
+def render_incidents(ledger: dict) -> str:
+    """The incident-ledger view of one chaos report: fault windows with
+    their attributed alerts and MTTD/MTTR, fleet percentiles per fault
+    class, burn-budget rows, and the unattributed alerts called out —
+    pure function of the report's `incidents` section."""
+    health = ledger.get("health") or {}
+    verdict = "GREEN" if health.get("ok") else "NOT GREEN"
+    lines = [
+        f"### Incident ledger ({health.get('incidents', 0)} incident(s), "
+        f"health {verdict})\n",
+        "| kind | window (s) | nodes | alerts | classes | MTTD (s) "
+        "| MTTR (s) | residual |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for row in ledger.get("incidents") or ():
+        end = "open" if row["end"] is None else f"{row['end']:.3f}"
+        nodes = (
+            "fleet"
+            if row["nodes"] is None
+            else ",".join(str(n) for n in row["nodes"])
+        )
+        classes = (
+            ", ".join(
+                f"{k}×{v}" for k, v in sorted(row["alert_classes"].items())
+            )
+            or "-"
+        )
+        lines.append(
+            f"| {row['kind']} | {row['start']:.3f}-{end} | {nodes} "
+            f"| {row['alerts']} | {classes} | {_fmt_s(row['mttd_s'])} "
+            f"| {_fmt_s(row['mttr_s'])} "
+            f"| {'RESIDUAL' if row['residual'] else '-'} |"
+        )
+    fleet = []
+    for label, section in (("MTTD", "mttd"), ("MTTR", "mttr")):
+        for kind, s in sorted((health.get(section) or {}).items()):
+            fleet.append(
+                f"- {label} {kind}: p50 {s['p50_ms']:.0f} ms, "
+                f"p99 {s['p99_ms']:.0f} ms over {s['count']} node-sample(s) "
+                f"(worst node {s['worst_node']})"
+            )
+    if fleet:
+        lines += ["", "#### Fleet detection/recovery percentiles", *fleet]
+    burn = health.get("burn") or {}
+    if burn:
+        lines += [
+            "",
+            "#### Burn budget",
+            "| SLO | burned (s) | budget (s) | verdict |",
+            "|---|---|---|---|",
+        ]
+        for slo, b in sorted(burn.items()):
+            if b["within_budget"] is None:
+                v = "unjudged"
+            else:
+                v = "within" if b["within_budget"] else "OVER"
+            lines.append(
+                f"| {slo} | {b['burn_s']:.3f} | {_fmt_s(b['budget_s'])} "
+                f"| {v} |"
+            )
+    unattributed = ledger.get("unattributed") or ()
+    if unattributed:
+        lines += [
+            "",
+            f"#### UNATTRIBUTED alerts ({len(unattributed)}) — no injected "
+            "fault explains these",
+            *(
+                f"- {u['class']} {u['name']} (node "
+                f"{u['node'] if u['node'] is not None else 'global'}) fired "
+                f"at t={u['fired']}"
+                for u in unattributed
+            ),
+        ]
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(prog="telemetry_dash", description=__doc__)
     src = ap.add_mutually_exclusive_group(required=True)
@@ -374,10 +460,28 @@ def main(argv: list[str] | None = None) -> int:
         "link accounting, per-vantage RTT class) instead of the node "
         "dashboard; needs --poll or --report",
     )
+    ap.add_argument(
+        "--incidents",
+        action="store_true",
+        help="render the incident ledger (fault windows, attributed "
+        "alerts, MTTD/MTTR, burn budget; utils/incidents.py) — needs "
+        "--report: the ledger is a run-level artifact, never scraped live",
+    )
     ap.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
     errors: list[str] = []
+    if args.incidents and not args.report:
+        print(
+            "--incidents reads a chaos report's `incidents` section; "
+            "use it with --report",
+            file=sys.stderr,
+        )
+        return 3
+    if args.incidents and args.peers:
+        print("--incidents and --peers are distinct views; pick one",
+              file=sys.stderr)
+        return 3
     if args.matrix and args.peers:
         print(
             "--peers renders per-node link tables; matrix artifacts only "
@@ -441,6 +545,20 @@ def main(argv: list[str] | None = None) -> int:
                 file=sys.stderr,
             )
             return 3
+        if args.incidents:
+            ledger = report.get("incidents")
+            if not isinstance(ledger, dict):
+                print(
+                    f"{args.report}: no `incidents` section — the report "
+                    "predates the incident ledger (re-run the scenario)",
+                    file=sys.stderr,
+                )
+                return 3
+            if args.json:
+                print(json.dumps(ledger, indent=2, sort_keys=True))
+            else:
+                print(render_incidents(ledger))
+            return 0
         records = (
             peer_records_from_report(report)
             if args.peers

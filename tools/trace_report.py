@@ -171,6 +171,70 @@ def load_wan_regions(paths: list[str]) -> dict[str, str]:
     return regions
 
 
+def load_incident_intervals(paths: list[str]) -> list[dict]:
+    """Incident rows from a chaos report's `incidents` ledger
+    (utils/incidents.py §5.5r): kind + [start, end] window + node scope.
+    The ledger shares the report's virtual clock with the flight
+    recorders, so block stamps and incident windows compare directly.
+    Per-node dump files carry no ledger and contribute nothing here."""
+    rows: list[dict] = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        ledger = d.get("incidents")
+        if isinstance(ledger, dict):
+            rows.extend(ledger.get("incidents") or ())
+    return rows
+
+
+def incident_annotation_table(blocks: dict, incidents: list[dict]) -> str:
+    """Per-block incident annotation: which ledger incident windows
+    overlap each traced block's propose->commit span. The join that turns
+    'this block was slow' into 'this block was slow INSIDE the flood
+    window' — absent (empty string) when the run had no ledger."""
+    if not incidents:
+        return ""
+    rows = []
+    for trace in sorted(blocks, key=_round_of):
+        per_node = blocks[trace]
+        stamps = [t for ts in per_node.values() for t in ts.values()]
+        if not stamps:
+            continue
+        t0, t1 = min(stamps), max(stamps)
+        hits = []
+        for inc in incidents:
+            end = inc["end"] if inc["end"] is not None else math.inf
+            if inc["start"] <= t1 and t0 <= end:
+                scope = (
+                    "fleet"
+                    if inc["nodes"] is None
+                    else ",".join(str(n) for n in inc["nodes"])
+                )
+                end_txt = "open" if inc["end"] is None else f"{inc['end']:.1f}"
+                hits.append(
+                    f"{inc['kind']}[{inc['start']:.1f}-{end_txt}]@{scope}"
+                )
+        if hits:
+            rows.append(
+                f"| {trace} | r{_round_of(trace)} | {t0:.3f}-{t1:.3f} "
+                f"| {'; '.join(hits)} |"
+            )
+    if not rows:
+        return (
+            "### Per-block incident overlap\n\n"
+            "(no traced block overlaps an incident window)"
+        )
+    return (
+        "### Per-block incident overlap (ledger windows covering each "
+        "block's propose->commit span)\n\n"
+        "| block | round | span (s) | incidents |\n"
+        "|---|---|---|---|\n" + "\n".join(rows)
+    )
+
+
 def stage_times(nodes: list[dict]) -> dict:
     """block trace id -> {node -> {stage -> earliest aligned time}}."""
     blocks: dict[str, dict[str, dict[str, float]]] = {}
@@ -785,6 +849,7 @@ def main(argv: list[str] | None = None) -> int:
         critical_path_table(
             blocks, load_peer_rtts(args.dumps), load_wan_regions(args.dumps)
         ),
+        incident_annotation_table(blocks, load_incident_intervals(args.dumps)),
         verify_lane_table(nodes),
         agg_bundle_table(nodes),
         ingress_leg_table(nodes),
